@@ -1,0 +1,3 @@
+"""TPU-native rebuild of HomebrewNLP-MTF (see SURVEY.md)."""
+
+from .config import BlockArgs, BlockConfig, LearningRateConfig, ModelParameter  # noqa: F401

@@ -1,0 +1,384 @@
+"""Config system: ModelParameter / BlockConfig / BlockArgs.
+
+Parses the exact JSON schema of the reference's configs/*.json
+(/root/reference/src/dataclass.py:34-341) so existing configs launch
+unchanged, and derives the TPU-native execution plan from it:
+
+- mesh axes ('data', 'model'[, 'sequence']) replacing the auto-derived mtf
+  mesh_shape "b:<tpu_size/heads>,h:<heads>" + layout "batch:b,heads:h"
+  (/root/reference/src/dataclass.py:247-252),
+- named Dims (core.dims.Dim) replacing mtf.Dimensions (:273-316),
+- jnp dtypes for the storage/slice/calculation triple (:253-255).
+
+New (TPU-first) keys, all defaulted so reference configs are unaffected:
+``sequence_parallel`` (shard the sequence dim over a mesh axis for
+long-context ring attention), ``mesh_shape_override``, ``scan_layers``.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dims import Dim
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+           "float16": jnp.float16, "float64": jnp.float32}
+
+
+class BlockConfig:
+    """One block part: list of layer strings + skip flag (reference :12-19)."""
+
+    def __init__(self, config, memory_reduction_strategy: str):
+        if isinstance(config, BlockConfig):
+            config = config.__dict__
+        self.layer: typing.List[str] = []
+        self.skip = False
+        self.memory_reduction_strategy = memory_reduction_strategy
+        self.__dict__.update(config)
+
+
+class LearningRateConfig:
+    def __init__(self, start_step: int = 0, final_step: int = 0, factor: float = 1.):
+        self.start_step = start_step
+        self.final_step = final_step
+        self.factor = factor
+
+
+class ModelParameter:
+    def __init__(self, config: typing.Dict[str, typing.Any]):
+        if isinstance(config, ModelParameter):
+            config = dict(config.__dict__)
+
+        # ---- defaults: key-for-key with /root/reference/src/dataclass.py:38-179
+        self.position_embedding = "absolute"
+        self.token_embedding = "absolute"
+        self.empty_frame_embedding = "absolute"
+        self.output_embedding = "absolute-orthogonal"
+        self.use_video = True
+        self.save_graph = False
+        self.use_language = True
+        self.contrastive_across_samples = False
+        self.contrastive_across_token_embeddings = False
+        self.input_dropout = 0.
+        self.output_offset = 1
+        self.weight_standardisation = True
+        self.use_checkpointing = False
+        self.max_checkpoints_keep = 1
+        self.steps_per_checkpoint = 100_000
+        self.time_patch = 1
+        self.patch_size = 16
+        self.frame_width = 320
+        self.frame_height = 176
+        self.opt_beta1 = 0.9
+        self.opt_beta2 = 0.999
+        self.vocab_size = 256
+        self.color_channels = 3
+        self.three_axes = True
+        self.dataset_configs: typing.List[dict] = []
+        self.data_seed = 456772
+        self.parallel_batch = None
+        self.parallel_interleave = None
+        self.use_random_dataloader = False
+        self.train = True
+        self.debug_sample = False
+        self.padding_token = 0
+        self.concat_token = 4
+        self.sequence_length = 32
+        self.heads = 8
+        self.features: typing.Optional[int] = None
+        self.features_per_head: typing.Optional[int] = None
+        self.depth = 16
+        self.buffer_size = 4
+        self.combine_assignments = False
+        self.shuffle_buffer = 256
+        self.interleaved_datasets = 256
+        self.token_patch_size = 1
+        self.learning_rate = 5e-5
+        self.storage_dtype = "float32"
+        self.slice_dtype = "float32"
+        self.calculation_dtype = "float32"
+        self.optimizer_slice_dtype = "float32"
+        self.optimizer_calculation_dtype = "float32"
+        self.learning_rate_config: typing.Dict[str, typing.Any] = {}
+        self.train_batch_size = 1
+        self.grad_accumulation = 1
+        self.macro_batching = 1
+        self.macro_batch_loss_smoothing = False
+        self.reduce_lr_on_plateau_timespan = 0
+        self.reduce_lr_on_plateau_reduction = 2
+        self.momentumnet_alpha = 0.99
+        self.current_step = 0
+        self.tpu_size = 32
+        self.default_sleep_duration = 0.1
+        self.lookahead_steps = 0
+        self.lookahead_alpha = 0
+        self.momentum = 0.95
+        self.prefix = "datasets/full_hd_video"
+        self.model_path = "runs/default"
+        self.tensorflow_optimization_settings = {}  # accepted, ignored (TF1-only)
+        self.language_token_per_frame = 0
+        self.weight_decay = 0.001
+        self.vocab_weight_factorization = 0.125
+        self.train_steps = 2 ** 30
+        self.warmup_steps = 3000
+        self.rezero_lr_multiplier = 0.1
+        self.learning_rate_decay_multi = 1
+        self.convolution_size = 16
+        self.learning_rate_decay_start_step = 100_000
+        self.learning_rate_decay_min = 5e-10
+        self.iterations = 2500
+        self.initial_autoregressive_position = 128
+        self.use_autoregressive_sampling = False
+        self.sampling_temperature = 0
+        self.weight_centralisation = True
+        self.shuffle_input_filenames = True
+        self.calc_accuracy = False
+        self.num_of_sample = 10
+        self.web_workers = 1
+        self.equal_debugging_items_per_check = 16
+        self.group_linear_factor = 2
+        self.embedding_stddev = 0.04
+        self.color_quantization_value = 256
+        self.experts = 64
+        self.pkm_axes = 2
+        self.use_bit_fold_input_pipeline = False
+        self.bit_fold_value = 4
+        self.debug_train_step = False
+        self.model_mode = 'jannet'
+        self.optimizer = 'learning_rate'
+        self.multi_loss_strategy = "linear"
+        self.memory_reduction_strategy = "revnet"
+        self.debug_gradients = False
+        self.use_initial_position_embedding = False
+        self.intermediate_feed_forward_multiplier = None
+        self.intermediate_feed_forward_multiplier_multiplier = None
+        self.own_color = "\x1b[32;1m"
+        self.other_color = "\x1b[0m"
+        self.scale_by_depth = True
+        self.z_loss = 1e-4
+        self.block_config: typing.Any = [
+            {'layer': ["norm-group-shift-scale",
+                       "feed_forward-in_relu-group-in_glu_add-in_norm"]},
+            {'layer': ["norm-group-std-shift-scale",
+                       "attention-in_relu-embedded-relative"]}]
+        self.input_block_config: typing.Any = []
+        self.output_block_config: typing.Any = []
+        self.masked_attention_dimensions = [0]
+        self.split_grad_accumulation = True
+        self.log_dict_keys: typing.List[str] = []
+
+        # ---- TPU-native additions (defaults keep reference configs unchanged)
+        self.sequence_parallel = 1           # size of the 'sequence' mesh axis
+        self.mesh_shape_override: typing.Optional[typing.Dict[str, int]] = None
+        self.scan_layers = False             # lax.scan over depth (faster compiles)
+        self.gradient_checkpointing_policy = "nothing_saveable"
+
+        for k, v in config.items():
+            if k not in self.__dict__:
+                print(f"WARNING: Unknown ModelParameter {k}={v!r}")
+            self.__dict__[k] = v
+
+        # ---- validation / derivation (reference :189-271)
+        assert self.macro_batching > 0, "macro_batching must be >= 1"
+        if isinstance(self.position_embedding, str):
+            self.position_embedding = self.position_embedding.split('-')
+        if isinstance(self.token_embedding, str):
+            self.token_embedding = self.token_embedding.split('-')
+        if isinstance(self.output_embedding, str):
+            self.output_embedding = self.output_embedding.split('-')
+        if isinstance(self.empty_frame_embedding, str):
+            self.empty_frame_embedding = self.empty_frame_embedding.split('-')
+
+        for attr in ("slice_dtype", "storage_dtype", "calculation_dtype",
+                     "optimizer_slice_dtype", "optimizer_calculation_dtype"):
+            v = getattr(self, attr)
+            if isinstance(v, str):
+                setattr(self, attr, _DTYPES[v])
+
+        self.learning_rate_config = {
+            key: cfg if isinstance(cfg, LearningRateConfig) else LearningRateConfig(**cfg)
+            for key, cfg in self.learning_rate_config.items()}
+
+        self.multi_loss_strategy = self.multi_loss_strategy.lower()
+        if self.multi_loss_strategy not in ("linear", "pcgrad", "mgda"):
+            print(f"{self.multi_loss_strategy} unsupported; defaulting to linear")
+            self.multi_loss_strategy = "linear"
+        if not self.use_language and not self.use_video:
+            raise ValueError("Language and video mode are disabled. No model can be built.")
+        if self.weight_standardisation and not self.weight_centralisation:
+            print("Can't standardise weights without centralizing them first. Enabling it.")
+            self.weight_centralisation = True
+        if self.features is None and self.features_per_head is None:
+            raise ValueError("Either features or features_per_head has to be specified")
+        if self.features is None:
+            self.features = self.features_per_head * self.heads
+        if self.features_per_head is None:
+            self.features_per_head = self.features // self.heads
+        if self.use_video and (self.frame_width * self.frame_height // self.patch_size) % self.experts:
+            raise ValueError("Frame size has to be divisible by number of experts")
+        if self.intermediate_feed_forward_multiplier_multiplier is not None:
+            self.intermediate_feed_forward_multiplier = (
+                self.group_linear_factor
+                * self.intermediate_feed_forward_multiplier_multiplier / self.heads)
+        if self.intermediate_feed_forward_multiplier is None:
+            self.intermediate_feed_forward_multiplier = self.group_linear_factor / self.heads
+        if not self.use_video and self.language_token_per_frame != self.sequence_length:
+            self.language_token_per_frame = self.sequence_length
+        if self.use_random_dataloader:
+            print('WARNING: Use random dataset seed')
+            self.data_seed = int(np.random.default_rng().integers(0, 1_000_000))
+
+        # ---- mesh derivation: reference's 2-D batch x heads mesh (:247-252),
+        # extended with an optional sequence axis for long-context sharding.
+        if self.mesh_shape_override:
+            self.mesh_shape = dict(self.mesh_shape_override)
+        else:
+            data_par = max(1, self.tpu_size // (self.heads * self.sequence_parallel)) \
+                if self.heads * self.sequence_parallel < self.tpu_size else 1
+            self.mesh_shape = {}
+            if data_par > 1:
+                self.mesh_shape["data"] = data_par
+            if self.heads > 1:
+                self.mesh_shape["model"] = self.heads
+            if self.sequence_parallel > 1:
+                self.mesh_shape["sequence"] = self.sequence_parallel
+            if not self.mesh_shape:
+                self.mesh_shape = {"data": 1}
+        # dim-name -> mesh-axis layout rules ("batch:b,heads:h" analogue)
+        self.layout = {}
+        if "data" in self.mesh_shape:
+            self.layout["batch"] = "data"
+        if "model" in self.mesh_shape:
+            self.layout["heads"] = "model"
+        if "sequence" in self.mesh_shape:
+            self.layout["sequence"] = "sequence"
+
+        self.block_config = [BlockConfig(c, self.memory_reduction_strategy)
+                             for c in self.block_config]
+        self.input_block_config = [BlockConfig(c, "checkpoint") for c in self.input_block_config]
+        self.output_block_config = [BlockConfig(c, "checkpoint") for c in self.output_block_config]
+
+        self.time_patch_size = self.sequence_length // self.time_patch
+        self.frame_height_patch = self.frame_height // self.patch_size
+        self.frame_width_patch = self.frame_width // self.patch_size
+        self.channel_color_size = self.color_channels * self.time_patch * self.patch_size ** 2
+        self.fold_count = 32 // self.bit_fold_value
+        if 2 ** self.bit_fold_value < self.color_quantization_value and self.use_bit_fold_input_pipeline:
+            raise ValueError("fold value must be >= color bit value when folding input")
+        self.language_token_patch = self.language_token_per_frame // self.token_patch_size
+        if self.use_bit_fold_input_pipeline:
+            self.channel_color_size //= self.fold_count
+
+        # ---- named dims (reference :273-316)
+        self.product_key_value_vectors = self.features_per_head ** 2
+        self.product_key_value_dim = Dim("product_key_value_dim", self.product_key_value_vectors)
+        self.head_dim = Dim("heads", self.heads)
+        self.head_dimensions = [self.head_dim]
+        self.key_dim = Dim("features_per_head", self.features // self.heads)
+        self.sequence_per_head_dim = Dim("sequence_per_head", self.time_patch_size // self.heads)
+        self.pkm_dim = Dim("pkm_axes", self.pkm_axes)
+        self.feature_dims = [self.head_dim, self.key_dim]
+        self.intermediate = [Dim("intermediate",
+                                 int(self.heads * self.key_dim.size
+                                     * self.intermediate_feed_forward_multiplier))]
+        self.expert_dim = Dim("experts", self.experts)
+        self.macro_batch_dim = Dim("batch", self.train_batch_size * self.macro_batching)
+        self.vocab_dim = Dim("vocab", self.vocab_size)
+        self.batch_dim = Dim("batch", self.train_batch_size)
+        self.frame_input_sequence = Dim("_sequence", self.time_patch_size + 1)
+
+        frame_input_shape = [self.batch_dim, self.frame_input_sequence]
+        if self.three_axes:
+            frame_input_shape += [Dim("height", self.frame_height_patch),
+                                  Dim("width", self.frame_width_patch)]
+        else:
+            frame_input_shape += [Dim("height", self.frame_height_patch * self.frame_width_patch)]
+        self.color_channel_dim = Dim("color_channels", self.channel_color_size)
+        frame_input_shape += [self.color_channel_dim]
+        self.frame_input_shape = frame_input_shape
+
+        self.sequence_dim = Dim("sequence", self.time_patch_size)
+        self.token_patch_dim = Dim("language_token_patch", self.token_patch_size)
+        self.token_dim_shape = [self.batch_dim, self.sequence_dim, self.token_patch_dim]
+        self.frame_mask_shape = [self.batch_dim, self.sequence_dim]
+
+        self.input_pipeline_shape: typing.Dict[str, list] = {}
+        if self.use_video:
+            self.input_pipeline_shape['frame'] = self.frame_input_shape
+            self.input_pipeline_shape['cat_mask_x'] = self.frame_mask_shape
+            self.input_pipeline_shape['cat_mask_y'] = self.frame_mask_shape
+            self.input_pipeline_shape['vid_msk_src'] = self.frame_mask_shape
+            self.input_pipeline_shape['vid_msk_tgt'] = self.frame_mask_shape
+            self.discrete_dim = [Dim("discrete", self.channel_color_size * self.color_quantization_value)]
+            self.discrete_color_dim = Dim("color_quantization", self.color_quantization_value)
+        if self.use_language:
+            self.input_pipeline_shape['token_x'] = self.token_dim_shape
+            self.input_pipeline_shape['token_y'] = self.token_dim_shape
+        if self.use_language and self.use_video:
+            self.token_dim_shape = [self.batch_dim, self.sequence_dim,
+                                    Dim("height", self.language_token_patch),
+                                    self.token_patch_dim]
+            self.input_pipeline_shape['token_x'] = self.token_dim_shape
+            self.input_pipeline_shape['token_y'] = self.token_dim_shape
+            self.input_pipeline_shape['txt_msk'] = self.token_dim_shape
+
+        # mutable build-time state (reset per build)
+        self.attention_idx = 0
+
+    def dict(self) -> typing.Dict[str, typing.Any]:
+        return self.__dict__
+
+    def __str__(self):
+        return str(self.__dict__)
+
+
+def align_tensor_op(x: typing.Dict[str, typing.Any]) -> typing.List[typing.Any]:
+    """Fixed input-tensor ordering (reference :375-384)."""
+    tensors = []
+    if 'frame' in x:
+        tensors.extend([x['frame'], x['cat_mask_x'], x['cat_mask_y'],
+                        x['vid_msk_src'], x['vid_msk_tgt']])
+    if 'token_x' in x:
+        tensors.extend([x['token_x'], x['token_y']])
+    if 'txt_msk' in x:
+        tensors.append(x['txt_msk'])
+    return tensors
+
+
+class BlockArgs:
+    """(params, tensor, name_extras) bundle flowing through every layer fn
+    (reference :387-419).  Note ``is_last`` is intentionally NOT propagated by
+    __call__ — the reference's BlockArgs.__call__ constructs the copy without
+    it, which silently disables scale_by_depth inside most layer bodies; we
+    reproduce that behavior for loss parity."""
+
+    def __init__(self, params: ModelParameter, tensor, name_extras: typing.List[str],
+                 is_last: bool = False):
+        self.params = params
+        self.tensor = tensor
+        self.name_extras = name_extras
+        self.is_last = is_last
+
+    def __call__(self, *args):
+        new = BlockArgs(self.params, self.tensor, self.name_extras[:])
+        for a in args:
+            if isinstance(a, ModelParameter):
+                new.params = a
+            elif isinstance(a, (list, tuple)):
+                new.name_extras = list(a)
+            elif isinstance(a, str):
+                new.name_extras.append(a)
+            else:  # NamedTensor
+                new.tensor = a
+        return new
+
+    def __iter__(self):
+        yield from self.name_extras
+
+    def __len__(self):
+        return len(self.name_extras)
+
+    def __getitem__(self, idx):
+        return self.name_extras[idx]

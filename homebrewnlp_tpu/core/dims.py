@@ -1,0 +1,111 @@
+"""Named-dimension algebra.
+
+TPU-native replacement for Mesh-TensorFlow's ``mtf.Dimension``/``mtf.Shape``
+(reference: /root/reference/src/utils_mtf.py).  Dimensions are (name, size)
+pairs; two dims are equal iff both name and size match, exactly like mtf.
+Dim *names* carry all semantics in this framework:
+
+- einsum contraction is driven by shared dim names (core/tensor.py),
+- sharding is driven by a dim-name -> mesh-axis map (core/sharding.py),
+- "anonymized" dims (leading ``_``) never match a mesh axis and are therefore
+  replicated — the same trick the reference uses to force replication
+  (/root/reference/src/utils_mtf.py:84-96,207-232), except here it is purely a
+  sharding annotation: XLA GSPMD inserts the all-gather, we never reshape.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Dim:
+    name: str
+    size: int
+
+    def __repr__(self) -> str:
+        return f"{self.name}={self.size}"
+
+
+DIM_LIST = typing.List[Dim]
+SHAPE = typing.Sequence[Dim]
+
+
+def anonymize_dim(dim: typing.Union[Dim, str], size: typing.Optional[int] = None) -> Dim:
+    """Leading-underscore copy of a dim; replicated under the layout rules.
+
+    Mirrors /root/reference/src/utils_mtf.py:84-96 (including the optional
+    size override used by group_linear's widened key dim).
+    """
+    name = dim.name if isinstance(dim, Dim) else dim
+    if not name.startswith("_"):
+        name = "_" + name
+    if size is None:
+        if not isinstance(dim, Dim):
+            raise ValueError("size required when anonymizing a bare name")
+        size = dim.size
+    return Dim(name, size)
+
+
+def unanonymize_dim(dim: Dim, size: typing.Optional[int] = None) -> Dim:
+    name = dim.name.lstrip("_")
+    return Dim(name, dim.size if size is None else size)
+
+
+def dim_name(dim: typing.Union[Dim, str]) -> str:
+    return dim.name if isinstance(dim, Dim) else dim
+
+
+def deduplicate(dims: SHAPE) -> DIM_LIST:
+    """Stable-order dedup (reference: src/utils_mtf.py deduplicate)."""
+    out: DIM_LIST = []
+    for d in dims:
+        if d not in out:
+            out.append(d)
+    return out
+
+
+def shape_size(dims: SHAPE) -> int:
+    return int(np.prod([d.size for d in dims], dtype=np.int64)) if dims else 1
+
+
+def shape_sub(shape: SHAPE, other: typing.Union[SHAPE, Dim]) -> DIM_LIST:
+    """Shape difference by dim equality, preserving order (mtf.Shape.__sub__)."""
+    if isinstance(other, Dim):
+        other = [other]
+    other = list(other)
+    return [d for d in shape if d not in other]
+
+
+def shape_addition(*shapes: SHAPE) -> DIM_LIST:
+    dims: DIM_LIST = []
+    for s in shapes:
+        dims.extend(s)
+    return deduplicate(dims)
+
+
+def shape_crossection(*shapes: SHAPE) -> DIM_LIST:
+    """Ordered intersection of shapes (reference: src/utils_mtf.py:394-397)."""
+    return [d for d in shape_addition(*shapes) if all(d in list(s) for s in shapes)]
+
+
+def missing_dims(self_shape: SHAPE, other: SHAPE) -> DIM_LIST:
+    return shape_sub(other, self_shape)
+
+
+def index_of(shape: SHAPE, dim: typing.Union[Dim, str]) -> int:
+    name = dim_name(dim)
+    for i, d in enumerate(shape):
+        if d.name == name and (not isinstance(dim, Dim) or d.size == dim.size):
+            return i
+    raise KeyError(f"dim {dim!r} not in shape {list(shape)!r}")
+
+
+def has_dim(shape: SHAPE, dim: typing.Union[Dim, str]) -> bool:
+    try:
+        index_of(shape, dim)
+        return True
+    except KeyError:
+        return False

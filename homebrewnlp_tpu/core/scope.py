@@ -1,0 +1,159 @@
+"""Deterministic hierarchical naming + two-phase (init/apply) parameter store.
+
+Replaces the reference's global ``NAME_INDICES`` variable-scope counters
+(/root/reference/src/utils_core.py:16-19,57-67) and TF1 variable reuse.  Names
+are hierarchical rather than global so any subtree (e.g. one reversible block)
+can be re-traced in isolation inside a ``jax.custom_vjp`` backward pass and
+still resolve the same parameter names.
+
+Two phases, haiku-style but in-tree:
+  * init: layer code runs once eagerly; ``get_param`` materialises numpy
+    values from per-name seeded initializers and records them.
+  * apply: same code path; ``get_param`` fetches arrays from the provided
+    dict (casting storage/slice dtype -> calculation dtype).
+
+All scope state lives in a context stack that exists only at trace time, so
+everything stays compatible with jit/grad/vmap.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import typing
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import NamedTensor, nt
+
+Params = typing.Dict[str, jax.Array]
+
+
+@dataclasses.dataclass
+class _Frame:
+    name: str
+    counters: typing.Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class Context:
+    """One build context: either collecting params (init) or reading them."""
+
+    def __init__(self, mode: str, params: typing.Optional[Params] = None,
+                 seed: int = 0, rng_key: typing.Optional[jax.Array] = None,
+                 record_touched: bool = False):
+        assert mode in ("init", "apply")
+        self.mode = mode
+        self.params: Params = {} if params is None else params
+        self.seed = seed
+        self.rng_key = rng_key
+        self.stack: typing.List[_Frame] = [_Frame("")]
+        self.touched: typing.Optional[typing.List[str]] = [] if record_touched else None
+        # arbitrary cross-layer caches (shared-variable machinery etc.)
+        self.cache: typing.Dict[str, typing.Any] = {}
+        self._rng_count = 0
+
+    # -- naming ------------------------------------------------------------
+    def enter(self, name: str) -> str:
+        frame = self.stack[-1]
+        idx = frame.counters.get(name, 0)
+        frame.counters[name] = idx + 1
+        scoped_name = f"{name}{idx}"
+        self.stack.append(_Frame(scoped_name))
+        return scoped_name
+
+    def exit(self):
+        self.stack.pop()
+
+    def path(self) -> str:
+        return "/".join(f.name for f in self.stack[1:])
+
+    def full_name(self, leaf: str) -> str:
+        frame = self.stack[-1]
+        idx = frame.counters.get(leaf, 0)
+        frame.counters[leaf] = idx + 1
+        p = self.path()
+        return f"{p}/{leaf}{idx}" if p else f"{leaf}{idx}"
+
+    # -- rng ---------------------------------------------------------------
+    def next_rng(self) -> typing.Optional[jax.Array]:
+        if self.rng_key is None:
+            return None
+        self._rng_count += 1
+        return jax.random.fold_in(self.rng_key, self._rng_count)
+
+
+_CTX: typing.List[Context] = []
+
+
+def current() -> Context:
+    if not _CTX:
+        raise RuntimeError("no active build Context; wrap model code in `with context(...)`")
+    return _CTX[-1]
+
+
+def in_context() -> bool:
+    return bool(_CTX)
+
+
+@contextlib.contextmanager
+def context(ctx: Context):
+    _CTX.append(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.pop()
+
+
+@contextlib.contextmanager
+def name_scope(name: str):
+    ctx = current()
+    ctx.enter(name)
+    try:
+        yield
+    finally:
+        ctx.exit()
+
+
+def scoped(name: str, fn: typing.Callable, *args, **kwargs):
+    """Run fn under a uniquified name scope (src/utils_core.py:16 analogue)."""
+    with name_scope(name):
+        return fn(*args, **kwargs)
+
+
+def name_seed(name: str, seed: int) -> np.random.Generator:
+    """Per-parameter deterministic RNG derived from (config seed, name)."""
+    return np.random.default_rng(np.random.Philox(key=[seed & (2 ** 64 - 1),
+                                                       zlib.crc32(name.encode())]))
+
+
+def get_param(name_leaf: str, dims, initializer, slice_dtype, calc_dtype
+              ) -> NamedTensor:
+    """Create (init) or fetch (apply) a parameter as a NamedTensor.
+
+    ``initializer(rng, sizes) -> np.ndarray`` runs in float32; stored in
+    slice_dtype (the mtf VariableDType.slice_dtype analogue,
+    /root/reference/src/dataclass.py:253-255), computed in calc_dtype.
+    """
+    ctx = current()
+    name = ctx.full_name(name_leaf)
+    dims = tuple(dims)
+    sizes = tuple(d.size for d in dims)
+    if ctx.mode == "init":
+        if name in ctx.params:
+            raise ValueError(f"duplicate parameter {name}")
+        value = np.asarray(initializer(name_seed(name, ctx.seed), sizes),
+                           dtype=np.float32)
+        assert value.shape == sizes, (name, value.shape, sizes)
+        # init stores host numpy (the "master" copy, mtf Saver-style);
+        # device placement + sharding happen at train setup, so init never
+        # touches an accelerator.
+        ctx.params[name] = value.astype(slice_dtype)
+    if name not in ctx.params:
+        raise KeyError(f"parameter {name} missing from provided params")
+    if ctx.touched is not None and name not in ctx.touched:
+        ctx.touched.append(name)
+    data = ctx.params[name]
+    assert tuple(data.shape) == sizes, (name, data.shape, sizes)
+    return nt(data.astype(calc_dtype), dims)

@@ -1,0 +1,290 @@
+"""Model graph assembly (reference: /root/reference/src/model/__init__.py).
+
+``build`` mirrors the reference's scoped _input/_body/_output/_loss pipeline
+(:203-228): video patch/bit-unfold + empty-frame embeds, factorized-vocab text
+embedding, depth × block_config body under a memory-reduction strategy, tied
+token head einsum + sigmoid video head, softmax-xent with z-loss,
+contrastive variants, L1 video loss, optional accuracy.
+
+``Model`` packages the two-phase init/apply around it: init materialises
+parameters and records the per-block parameter plan used by the reversible /
+checkpointed body (model/blocks.py).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from ..config import BlockArgs, ModelParameter
+from ..core import scope
+from ..core.dims import Dim, shape_sub
+from ..core.tensor import (NamedTensor, add_n, argmax, cast, concat,
+                           dropout as tensor_dropout, einsum, equal,
+                           nt, ones, reciprocal, reduce_sum, sigmoid, sign,
+                           slice_, sqrt, square, weighted_add)
+from .backend import linear_from_features, linear_to_features
+from .blocks import BlockSpec, run_body_blocks
+from .embedding import batched_gather, embed, gather_embed
+from .frontend import block_part_fn
+
+LossInfo = typing.NamedTuple("LossInfo", [("total_loss", typing.Any),
+                                          ("loss_list", list),
+                                          ("video_loss", typing.Any),
+                                          ("accuracy", typing.Any),
+                                          ("token_loss", typing.Any),
+                                          ("frame_out", typing.Any),
+                                          ("token_out", typing.Any)])
+
+
+def _default_ones(params: ModelParameter, inp) -> NamedTensor:
+    if inp is None:
+        return ones([], params.calculation_dtype)
+    return cast(inp, params.calculation_dtype)
+
+
+def _input(params: ModelParameter, vid, cat_msk_src, txt_src, vid_msk_src,
+           spatial_ctx: Dim, storage: dict):
+    tgt = None
+    src = None
+    if params.use_video:
+        base_args = BlockArgs(params, vid, [''])
+        vid = cast(vid, params.calculation_dtype)
+        vid = tensor_dropout(vid, params.train, 1 - params.input_dropout,
+                             scope.current().next_rng())
+
+        if params.use_bit_fold_input_pipeline:
+            folded = cast(vid, jnp.int64)
+            concat_list = []
+            for unfold_idx in range(params.fold_count):
+                part = (folded.data // ((2 ** params.bit_fold_value) ** unfold_idx)
+                        ) % (2 ** params.bit_fold_value)
+                concat_list.append(nt(part.astype(jnp.uint8), folded.dims))
+            vid = concat(concat_list, 'color_channels')
+
+        vid = cast(vid, params.calculation_dtype) / 255
+        context_dimension = vid.dims[1]
+        input_features = [vid.dims[-1]]
+        tgt = slice_(vid, 1, context_dimension.size, context_dimension)
+        src = slice_(vid, 0, context_dimension.size - 1, context_dimension)
+
+        if params.empty_frame_embedding is not None:
+            embed_args = base_args(params.empty_frame_embedding)
+            src = weighted_add(src, embed(embed_args, list(vid.dims[2:])), vid_msk_src)
+            src = weighted_add(src, embed(embed_args, list(vid.dims[2:])), cat_msk_src)
+
+        src = linear_to_features(base_args(src), input_features)
+
+        for config_idx, config in enumerate(params.input_block_config):
+            src = block_part_fn(params, config, src, f'vid_inp{config_idx}')
+
+    if params.use_language:
+        base_args = BlockArgs(params, txt_src, [''])
+        intermediate = Dim(params.intermediate[0].name,
+                           int(params.intermediate[0].size * params.vocab_weight_factorization))
+        txt_args = base_args(txt_src, list(params.token_embedding))
+        txt = gather_embed(txt_args, [params.vocab_dim, intermediate], storage=storage)
+        txt = tensor_dropout(txt, params.train, 1 - params.input_dropout,
+                             scope.current().next_rng())
+        txt = linear_to_features(base_args(txt), [params.token_patch_dim, intermediate])
+
+        for config_idx, config in enumerate(params.input_block_config):
+            txt = block_part_fn(params, config, txt, f'lang_inp{config_idx}')
+
+    if params.use_video and params.use_language:
+        # src: [batch, sequence, height_v, width?, feat...] / txt joins on the
+        # spatial_ctx axis exactly as the reference concat (model/__init__.py:88)
+        return concat([src, txt], spatial_ctx.name), tgt
+    if not params.use_video:
+        return txt, tgt
+    return src, tgt
+
+
+def _body(params: ModelParameter, src: NamedTensor,
+          plan) -> typing.Tuple[NamedTensor, tuple]:
+    base_args = BlockArgs(params, src, [''])
+    if params.use_initial_position_embedding:
+        for dim in shape_sub(src.dims, params.feature_dims)[1:]:
+            src = src + embed(base_args(list(params.position_embedding)),
+                              [dim] + list(params.feature_dims))
+    return run_body_blocks(params, src, plan)
+
+
+def _output(params: ModelParameter, out: NamedTensor, spatial_ctx: Dim):
+    base_args = BlockArgs(params, out, [''])
+    token_out = frame_out = None
+
+    contrastive = (params.contrastive_across_token_embeddings
+                   or params.contrastive_across_samples)
+    if params.use_language:
+        token_out = slice_(out, 0, params.language_token_patch, spatial_ctx.name) \
+            if params.use_video else out
+        if not contrastive:
+            for config_idx, config in enumerate(params.output_block_config):
+                token_out = block_part_fn(params, config, token_out, f'lang_out{config_idx}')
+            new = [params.token_patch_dim, params.vocab_dim]
+            old = list(params.feature_dims)
+            emb = embed(base_args(list(params.output_embedding)), old + new)
+            token_out = einsum([token_out, emb],
+                               output_shape=shape_sub(token_out.dims, old) + new)
+
+    if params.use_video:
+        frame_out = slice_(out, params.language_token_patch * params.use_language,
+                           out.dim(spatial_ctx.name).size, spatial_ctx.name)
+        for config_idx, config in enumerate(params.output_block_config):
+            frame_out = block_part_fn(params, config, frame_out, f'vid_out{config_idx}')
+        frame_out = sigmoid(linear_from_features(base_args(frame_out),
+                                                 [params.color_channel_dim]))
+    return frame_out, token_out
+
+
+def softmax_cross_entropy_with_logits(params: ModelParameter, logits: NamedTensor,
+                                      targets: NamedTensor) -> NamedTensor:
+    """Max-subtracted xent + z-loss (reference: src/mtf_wrapper.py:64-71)."""
+    from ..core.tensor import (exp, log, one_hot, reduce_max, stop_gradient,
+                               reduce_sum as rsum, constant)
+    max_logit = reduce_max(stop_gradient(logits), reduced_dim=params.vocab_dim)
+    log_z = log(rsum(exp(logits - max_logit), reduced_dim=params.vocab_dim)) + max_logit
+    tgt_size = targets.size
+    oh = one_hot(targets, params.vocab_dim, dtype=logits.dtype)
+    loss = einsum([logits - log_z, oh, constant(-1 / tgt_size, logits.dtype)], [])
+    if params.z_loss:
+        loss = loss + einsum([log_z, log_z,
+                              constant(params.z_loss / tgt_size, logits.dtype)], [])
+    return loss
+
+
+def _loss(params: ModelParameter, frame_out, token_out, txt_tgt, loss_list,
+          vid_msk_tgt, cat_msk_tgt, vid_tgt, storage: dict):
+    token_loss = accuracy = video_loss = None
+    if params.use_language:
+        if params.contrastive_across_samples or params.contrastive_across_token_embeddings:
+            token_out = token_out / sqrt(reduce_sum(square(token_out),
+                                                    reduced_dim=params.feature_dims))
+        if params.contrastive_across_samples:
+            sum_across_samples = reduce_sum(token_out, reduced_dim=params.sequence_dim)
+            sum_across_batch = reduce_sum(token_out, reduced_dim=params.batch_dim)
+            token_loss = einsum([sum_across_batch, sum_across_batch], []) / params.train_batch_size
+            token_loss = token_loss - einsum([sum_across_samples, sum_across_samples],
+                                             []) / params.sequence_length
+            token_loss = token_loss / (params.train_batch_size * params.sequence_length)
+        elif params.contrastive_across_token_embeddings:
+            emb = storage['text_input_embedding']
+            token_loss = einsum([token_out, emb], [])
+            gathered = batched_gather(emb, txt_tgt, [params.head_dim])
+            token_loss = token_loss - einsum([token_out, gathered], []) * 2
+            token_loss = token_loss / (token_out.size * params.vocab_size)
+        else:
+            token_loss = softmax_cross_entropy_with_logits(params, token_out, txt_tgt)
+        loss_list.append(token_loss)
+        if params.calc_accuracy:
+            acc = cast(equal(argmax(token_out, params.vocab_dim), txt_tgt),
+                       params.calculation_dtype)
+            accuracy = reduce_sum(acc, output_shape=[]) / txt_tgt.size
+
+    if params.use_video:
+        out = frame_out - vid_tgt
+        video_loss = einsum([out, vid_msk_tgt, cat_msk_tgt,
+                             nt(jnp.asarray(1 / frame_out.size,
+                                            params.calculation_dtype), ()),
+                             sign(out)], [])
+        loss_list.append(video_loss)
+        if vid_msk_tgt is not None:
+            video_loss = einsum([nt(jnp.asarray(float(vid_msk_tgt.size),
+                                                params.calculation_dtype), ()),
+                                 reciprocal(reduce_sum(vid_msk_tgt)),
+                                 nt(jnp.asarray(float(cat_msk_tgt.size),
+                                                params.calculation_dtype), ()),
+                                 reciprocal(reduce_sum(cat_msk_tgt)),
+                                 video_loss], [])
+    return loss_list, token_loss, accuracy, video_loss
+
+
+def _build(params: ModelParameter, vid, cat_msk_src, cat_msk_tgt, txt_src,
+           txt_tgt, vid_msk_src, vid_msk_tgt, txt_msk, plan):
+    cat_msk_src = _default_ones(params, cat_msk_src) if params.use_video else cat_msk_src
+    cat_msk_tgt = _default_ones(params, cat_msk_tgt) if params.use_video else cat_msk_tgt
+    vid_msk_src = _default_ones(params, vid_msk_src) if params.use_video else vid_msk_src
+    vid_msk_tgt = _default_ones(params, vid_msk_tgt) if params.use_video else vid_msk_tgt
+
+    loss_list: list = []
+    spatial_ctx: Dim = txt_tgt.dims[-2] if params.use_language else vid.dims[2]
+    storage: dict = {}
+
+    src, vid_tgt = scope.scoped("input", _input, params, vid, cat_msk_src,
+                                txt_src, vid_msk_src, spatial_ctx, storage)
+    out, plan = scope.scoped("body", _body, params, src, plan)
+    frame_out, token_out = scope.scoped("output", _output, params, out, spatial_ctx)
+    loss_list, token_loss, accuracy, video_loss = scope.scoped(
+        "loss", _loss, params, frame_out, token_out, txt_tgt, loss_list,
+        vid_msk_tgt, cat_msk_tgt, vid_tgt, storage)
+
+    params.attention_idx = 0
+    return LossInfo(add_n(loss_list), loss_list, video_loss, accuracy,
+                    token_loss, frame_out, token_out), plan
+
+
+def build(params: ModelParameter, vid, cat_msk_src, cat_msk_tgt, txt_src,
+          txt_tgt, vid_msk_src, vid_msk_tgt, txt_msk, plan=None):
+    return scope.scoped(params.model_mode, _build, params, vid, cat_msk_src,
+                        cat_msk_tgt, txt_src, txt_tgt, vid_msk_src,
+                        vid_msk_tgt, txt_msk, plan)
+
+
+class Model:
+    """Two-phase wrapper: ``init`` materialises params + block plan,
+    ``apply`` is a pure function of (params, inputs) suitable for jit/grad."""
+
+    def __init__(self, params: ModelParameter):
+        self.params = params
+        self.plan: typing.Optional[typing.Tuple[BlockSpec, ...]] = None
+
+    def _named_inputs(self, batch: typing.Dict[str, jax.Array]):
+        p = self.params
+        def get(key, dims):
+            if key not in batch or batch[key] is None:
+                return None
+            return nt(batch[key], dims)
+        vid = get('frame', p.frame_input_shape)
+        token_x = get('token_x', p.token_dim_shape)
+        token_y = get('token_y', p.token_dim_shape)
+        cat_msk_x = get('cat_mask_x', p.frame_mask_shape)
+        cat_msk_y = get('cat_mask_y', p.frame_mask_shape)
+        vid_msk_src = get('vid_msk_src', p.frame_mask_shape)
+        vid_msk_tgt = get('vid_msk_tgt', p.frame_mask_shape)
+        txt_msk = get('txt_msk', p.token_dim_shape)
+        return vid, cat_msk_x, cat_msk_y, token_x, token_y, vid_msk_src, vid_msk_tgt, txt_msk
+
+    def init(self, batch: typing.Dict[str, jax.Array], seed: typing.Optional[int] = None
+             ) -> typing.Dict[str, jax.Array]:
+        """Materialise parameters (host numpy) and the block plan.
+
+        The forward pass is traced abstractly (eval_shape) so init performs
+        no device computation at all — parameters are numpy master copies;
+        the trainer device_puts them with their NamedShardings.
+        """
+        ctx = scope.Context("init", seed=self.params.data_seed if seed is None else seed,
+                            record_touched=True)
+
+        def _run(abstract_batch):
+            with scope.context(ctx):
+                args = self._named_inputs(abstract_batch)
+                self.params.attention_idx = 0
+                info, self.plan = build(self.params, *args, plan=None)
+            return info.total_loss
+
+        jax.eval_shape(_run, {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                              for k, v in batch.items() if v is not None})
+        return ctx.params
+
+    def apply(self, variables: typing.Dict[str, jax.Array],
+              batch: typing.Dict[str, jax.Array],
+              rng: typing.Optional[jax.Array] = None) -> LossInfo:
+        assert self.plan is not None, "call init() first (or assign .plan)"
+        ctx = scope.Context("apply", params=variables, rng_key=rng)
+        with scope.context(ctx):
+            args = self._named_inputs(batch)
+            self.params.attention_idx = 0
+            info, _ = build(self.params, *args, plan=self.plan)
+        return info

@@ -1,0 +1,87 @@
+"""Activation registry (reference: /root/reference/src/model/activation.py).
+
+The reference hand-writes forward AND backward slicewise kernels for
+mish/silu/lecun_tanh/softsign because mtf can't differentiate through
+``cwise``; under jax every one of these is a plain jnp expression with native
+AD, and XLA fuses them into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import BlockArgs
+from ..core import scope
+from ..core.tensor import (NamedTensor, einsum, multiply, sigmoid as _sigmoid,
+                           softplus, tanh as _tanh, unary)
+import jax
+import jax.numpy as jnp
+
+
+def _gelu(args: BlockArgs) -> NamedTensor:
+    """tanh-approx gelu, exactly the reference's einsum formulation
+    (activation.py:158-161)."""
+    x = args.tensor
+    inner = einsum([x, x, x, __const(x, 0.044715)], x.dims) + x * np.sqrt(2 / np.pi)
+    return einsum([x, _tanh(inner) + 1.0, __const(x, 0.5)], x.dims)
+
+
+def __const(like: NamedTensor, value: float) -> NamedTensor:
+    from ..core.tensor import constant
+    return constant(value, like.dtype)
+
+
+def _relu(args):
+    return unary(jax.nn.relu, args.tensor)
+
+
+def _sigmoid_fn(args):
+    return _sigmoid(args.tensor)
+
+
+def _tanh_fn(args):
+    return _tanh(args.tensor)
+
+
+def _lecun_tanh(args):
+    # tanh(x) + 0.1 * x (activation.py:93-94)
+    return unary(lambda x: jnp.tanh(x) + x * 0.1, args.tensor)
+
+
+def _silu(args):
+    return unary(lambda x: x * jax.nn.sigmoid(x), args.tensor)
+
+
+def _mish(args):
+    return multiply(_tanh(softplus(args.tensor)), args.tensor)
+
+
+def _softsign(args):
+    # x / (1 + |x|) (activation.py:126-127)
+    return unary(lambda x: x / (1. + jnp.abs(x)), args.tensor)
+
+
+def _exp(args):
+    return unary(jnp.exp, args.tensor)
+
+
+ACTIVATIONS = {'relu': _relu,
+               'sigmoid': _sigmoid_fn,
+               'tanh': _tanh_fn,
+               'gelu': _gelu,
+               'lecun_tanh': _lecun_tanh,
+               'silu': _silu,
+               'mish': _mish,
+               'mtf_mish': _mish,
+               'softsign': _softsign,
+               'exp': _exp,
+               }
+
+
+def activate(args: BlockArgs) -> NamedTensor:
+    """First recognised activation flag wins; identity otherwise
+    (activation.py:200-211)."""
+    for fn_name in args:
+        if fn_name not in ACTIVATIONS:
+            continue
+        return scope.scoped(fn_name, ACTIVATIONS[fn_name], args)
+    return args.tensor

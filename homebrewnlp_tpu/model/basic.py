@@ -1,0 +1,157 @@
+"""Basic layers (reference: /root/reference/src/model/basic.py).
+
+rezero, dropout, wrapped_linear, soft mixture-of-experts, activated_linear
+(glu / glu_add / norm flags with in:/mid:/out: prefix scoping), feed_forward,
+group_linear (per-head grouped linear via the anonymized key dim),
+sum_heads, transpose_sequence_features, reduced_half_linear, product-key
+memory, bottleneck_group_linear.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..config import BlockArgs
+from ..core import scope
+from ..core.dims import shape_sub
+from ..core.tensor import (NamedTensor, cast, dropout as tensor_dropout,
+                           einsum, exp, multiply, reduce_max, reduce_sum,
+                           reciprocal, rename_dim, reshape, sigmoid,
+                           stop_gradient, top_1, transpose_to, unbind)
+from .activation import activate
+from .backend import ConstantInit, get_var, linear, orthogonal_var
+from .embedding import gather_embed
+from .normalization import norm
+from .utils import anonymize_dim, anonymize_shape, linear_shapes
+
+
+def rezero(args: BlockArgs) -> NamedTensor:
+    return args.tensor * get_var(args, [], ConstantInit(0.))
+
+
+def dropout(args: BlockArgs) -> NamedTensor:
+    keep = 1.
+    for extra in args.name_extras:
+        if extra.startswith("dropout_rate"):
+            keep = 1 - float(extra[len("dropout_rate"):])
+    return tensor_dropout(args.tensor, args.params.train, keep,
+                          scope.current().next_rng())
+
+
+def wrapped_linear(args: BlockArgs) -> NamedTensor:
+    return linear(args, *linear_shapes(args))
+
+
+def mixture_of_experts(args: BlockArgs) -> NamedTensor:
+    """Dense softmax-gated expert einsum (basic.py:37-44) — no routing, no
+    all-to-all; the experts dim can be placed on the mesh for true EP."""
+    params = args.params
+    old, new = linear_shapes(args)
+    gate = linear(args, old, [params.expert_dim])
+    gate = gate - stop_gradient(reduce_max(gate, reduced_dim=params.expert_dim))
+    gate = exp(gate)
+    out_shape = shape_sub(args.tensor.dims, old) + list(new)
+    return einsum([reciprocal(reduce_sum(gate, reduced_dim=params.expert_dim)),
+                   args.tensor, gate,
+                   orthogonal_var(args, list(old) + list(new) + [params.expert_dim])],
+                  output_shape=out_shape)
+
+
+def activated_linear(args: BlockArgs, prefix: str) -> NamedTensor:
+    args = args([a[len(prefix):] for a in args if a.startswith(prefix)])
+    feed_forward_fn = mixture_of_experts if "mixture_of_experts" in args.name_extras \
+        else wrapped_linear
+    out = dropout(args(activate(args(feed_forward_fn(args)))))
+    if "glu" in args.name_extras or "glu_add" in args.name_extras:
+        out = multiply(out, sigmoid(feed_forward_fn(args)))
+    if "glu_add" in args.name_extras:
+        out = out + activate(args(feed_forward_fn(args)))
+    if "norm" in args.name_extras:
+        out = norm(args(out))
+    return out
+
+
+def activated_linear_in(args: BlockArgs) -> NamedTensor:
+    return activated_linear(args, "in:")
+
+
+def activated_linear_out(args: BlockArgs) -> NamedTensor:
+    return activated_linear(args, "out:")
+
+
+def feed_forward(args: BlockArgs) -> NamedTensor:
+    return activated_linear_out(args(activated_linear_in(args)))
+
+
+def group_linear(args: BlockArgs) -> NamedTensor:
+    """Per-head grouped linear: project features -> anonymized key dim and
+    rename back (basic.py:72-74).  The reference's reshape round-trip is a
+    pure rename here."""
+    params = args.params
+    anonymous_key = anonymize_shape(params.feature_dims, params.key_dim)
+    out = linear(args("group"), list(params.feature_dims), anonymous_key)
+    return rename_dim(out, anonymize_dim(params.key_dim), params.key_dim.name)
+
+
+def sum_heads(args: BlockArgs) -> NamedTensor:
+    return reduce_sum(args.tensor, reduced_dim=args.params.head_dim)
+
+
+def transpose_sequence_features(args: BlockArgs) -> NamedTensor:
+    """Swap sequence and feature axes (basic.py:81-86)."""
+    params = args.params
+    assert params.features_per_head == params.sequence_length, \
+        "transpose_sequence_features requires features_per_head == sequence_length"
+    tensor = rename_dim(args.tensor, params.sequence_dim.name, "intermediate")
+    tensor = rename_dim(tensor, params.key_dim.name, params.sequence_dim.name)
+    tensor = rename_dim(tensor, "intermediate", params.key_dim.name)
+    return transpose_to(tensor, args.tensor.dims)
+
+
+def reduced_half_linear(args: BlockArgs) -> NamedTensor:
+    return group_linear(args(reduce_sum(args.tensor, reduced_dim=args.params.head_dim)))
+
+
+def product_key_memory(args: BlockArgs) -> NamedTensor:
+    """Two/three-axis product-key memory with top-1 per axis + batched gather
+    (basic.py:93-115)."""
+    params = args.params
+    anonymous_key = anonymize_dim(params.key_dim)
+    features = [params.pkm_dim, anonymous_key]
+    assignment = linear(args, linear_shapes(args).old, [params.head_dim] + features)
+    assignment = norm(args(assignment), features)
+    assignment = cast(assignment, jnp.float32)  # f64 in reference; f32 on TPU
+    normalizer = reduce_max(assignment, reduced_dim=anonymous_key)
+    normalizer = reduce_sum(normalizer, reduced_dim=params.pkm_dim)
+    assignment = assignment - stop_gradient(normalizer)
+    assignment = exp(assignment)
+    normalizer = reduce_sum(assignment, output_shape=shape_sub(assignment.dims, [anonymous_key]))
+    normalizer = einsum(unbind(normalizer, params.pkm_dim),
+                        output_shape=shape_sub(normalizer.dims, [params.pkm_dim]))
+
+    val, idx = top_1(assignment, anonymous_key)
+    powers = jnp.asarray([params.features_per_head ** i for i in range(params.pkm_axes)],
+                         dtype=jnp.int32)
+    from ..core.tensor import nt
+    powers_nt = nt(powers, [params.pkm_dim])
+    idx = einsum([powers_nt, idx], output_shape=shape_sub(idx.dims, [params.pkm_dim]))
+    val = einsum(unbind(val, params.pkm_dim),
+                 output_shape=shape_sub(val.dims, [params.pkm_dim])) / normalizer
+    val = cast(val, params.calculation_dtype)
+    out = gather_embed(args(idx), [params.product_key_value_dim] + list(params.feature_dims),
+                       [params.head_dim])
+    return out * val
+
+
+def feed_forward_product_key_memory(args: BlockArgs) -> NamedTensor:
+    return product_key_memory(args(activated_linear_in(args)))
+
+
+def bottleneck_group_linear(args: BlockArgs) -> NamedTensor:
+    """features -> bottleneck(intermediate) -> widened grouped mid -> grouped
+    out (basic.py:122-126); the workhorse of the flagship mixer configs."""
+    args = args(activated_linear_in(args))
+    args.name_extras.extend(["group", "mid:group", "out:group"])
+    args = args(activated_linear(args, "mid:"))
+    return activated_linear_out(args)

@@ -1,0 +1,214 @@
+"""Memory-reduction strategies over the block stack.
+
+Reference (/root/reference/src/model/__init__.py:101-126) supports four:
+  revnet    — reversible residual coupling y1 = x1 + f(x2) (revnet.py:14),
+  momentum  — invertible momentum residual v' = αv + (1-α)f(x); x' = x + v'
+              (momentumnet.py:20-27),
+  checkpoint— gradient checkpointing (mtf.recompute_grad),
+  none      — plain.
+
+The reference implements revnet/momentum as custom mtf Operations whose
+``gradient()`` clones the forward subgraph and streams per-variable grads
+(revnet.py:55-120).  Here each is a ``jax.custom_vjp`` over the whole block
+sequence: forward keeps only the two output streams; backward reconstructs
+activations layer-by-layer and calls ``jax.vjp`` on the re-traced block —
+O(1) activation memory in depth, with XLA-visible (and thus
+schedulable/fusable) recomputation.
+
+Each block is re-traced in isolation through a "replay" function that opens a
+fresh scope Context seeded with that block's parameter subset — hierarchical
+naming (core/scope.py) guarantees the replay resolves identical parameter
+names to the original trace.
+"""
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax
+
+from ..config import BlockConfig, ModelParameter
+from ..core import scope
+from ..core.tensor import NamedTensor
+from .frontend import block_part_fn
+
+Subset = typing.Dict[str, jax.Array]
+BlockSpec = typing.Tuple[int, int, typing.Tuple[str, ...]]  # (depth, cfg, names)
+
+
+class ReplayBlock:
+    """Hashable callable re-tracing one block under its own param subset."""
+
+    def __init__(self, params: ModelParameter, block_config: BlockConfig,
+                 depth_idx: int, cfg_idx: int, prefix: typing.Tuple[str, ...],
+                 attention_idx: int):
+        self.params = params
+        self.block_config = block_config
+        self.depth_idx = depth_idx
+        self.cfg_idx = cfg_idx
+        self.prefix = prefix
+        self.attention_idx = attention_idx
+        self._key = (id(params), depth_idx, cfg_idx, prefix)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, ReplayBlock) and self._key == other._key
+
+    def __call__(self, subset: Subset, x: NamedTensor) -> NamedTensor:
+        outer_rng = None
+        if scope.in_context():
+            outer_rng = scope.current().rng_key
+        ctx = scope.Context("apply", params=subset, rng_key=None)
+        if outer_rng is not None:
+            ctx.rng_key = jax.random.fold_in(outer_rng,
+                                             self.depth_idx * 131 + self.cfg_idx)
+        for seg in self.prefix:
+            ctx.stack.append(scope._Frame(seg))
+        # attention axis round-robin must replay identically
+        saved = self.params.attention_idx
+        self.params.attention_idx = self.attention_idx
+        try:
+            with scope.context(ctx):
+                return block_part_fn(self.params, self.block_config, x,
+                                     f"block{self.depth_idx}_{self.cfg_idx}")
+        finally:
+            self.params.attention_idx = saved
+
+
+def _block_scope_name(depth_idx: int, cfg_idx: int) -> str:
+    return f"block{depth_idx}_{cfg_idx}"
+
+
+# ---- reversible sequence -------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def rev_sequence(fns, subsets, x1, x2):
+    for f, s in zip(fns, subsets):
+        x1, x2 = x2, x1 + f(s, x2)
+    return x1, x2
+
+
+def _rev_fwd(fns, subsets, x1, x2):
+    out = rev_sequence(fns, subsets, x1, x2)
+    return out, (subsets, out)
+
+
+def _rev_bwd(fns, res, cot):
+    subsets, (a, b) = res
+    da, db = cot
+    dsubsets: typing.List[typing.Any] = [None] * len(fns)
+    for i in range(len(fns) - 1, -1, -1):
+        f, s = fns[i], subsets[i]
+        b_prev = a
+        fval, fvjp = jax.vjp(f, s, b_prev)
+        a_prev = b - fval
+        ds, db_extra = fvjp(db)
+        da_prev = db
+        db_prev = da + db_extra
+        a, b = a_prev, b_prev
+        da, db = da_prev, db_prev
+        dsubsets[i] = ds
+    return tuple(dsubsets), da, db
+
+
+rev_sequence.defvjp(_rev_fwd, _rev_bwd)
+
+
+# ---- invertible momentum sequence ---------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def momentum_sequence(fns, alpha, subsets, x, v):
+    for f, s in zip(fns, subsets):
+        v = v * alpha + f(s, x) * (1 - alpha)
+        x = x + v
+    return x, v
+
+
+def _mom_fwd(fns, alpha, subsets, x, v):
+    out = momentum_sequence(fns, alpha, subsets, x, v)
+    return out, (subsets, out)
+
+
+def _mom_bwd(fns, alpha, res, cot):
+    subsets, (x, v) = res
+    dx, dv = cot
+    dsubsets: typing.List[typing.Any] = [None] * len(fns)
+    for i in range(len(fns) - 1, -1, -1):
+        f, s = fns[i], subsets[i]
+        x_prev = x - v
+        fval, fvjp = jax.vjp(f, s, x_prev)
+        v_prev = (v - fval * (1 - alpha)) / alpha
+        g = dx + dv  # cotangent on v' (feeds both outputs)
+        ds, dx_f = fvjp(g)
+        dx_prev = dx + dx_f * (1 - alpha)
+        dv_prev = g * alpha
+        x, v = x_prev, v_prev
+        dx, dv = dx_prev, dv_prev
+        dsubsets[i] = ds
+    return tuple(dsubsets), dx, dv
+
+
+momentum_sequence.defvjp(_mom_fwd, _mom_bwd)
+
+
+# ---- body assembly -------------------------------------------------------
+
+def run_body_blocks(params: ModelParameter, src: NamedTensor,
+                    plan: typing.Optional[typing.Tuple[BlockSpec, ...]]
+                    ) -> typing.Tuple[NamedTensor, typing.Tuple[BlockSpec, ...]]:
+    """Run depth × block_config with the configured memory strategy.
+
+    In init mode (plan None) blocks run plainly in the outer context and the
+    per-block touched-parameter plan is recorded.  In apply mode the plan
+    feeds explicit parameter subsets into the custom-vjp sequences.
+    """
+    ctx = scope.current()
+    strategy = params.memory_reduction_strategy
+    blocks = [(i, c, bc) for i in range(params.depth)
+              for c, bc in enumerate(params.block_config)]
+
+    if ctx.mode == "init" or plan is None:
+        specs: typing.List[BlockSpec] = []
+        out = src
+        prev_touched = ctx.touched
+        for i, c, bc in blocks:
+            ctx.touched = []
+            out = block_part_fn(params, bc, out, _block_scope_name(i, c))
+            specs.append((i, c, tuple(ctx.touched)))
+        ctx.touched = prev_touched
+        if strategy in ("revnet", "momentum"):
+            # init forward ran the plain composition; the strategies compute
+            # x+f stacks whose *values* differ from the plain stack, but init
+            # only materialises parameters, so values are irrelevant here.
+            pass
+        return out, tuple(specs)
+
+    prefix = tuple(f.name for f in ctx.stack[1:])
+    fns = []
+    subsets = []
+    attn_idx = params.attention_idx
+    for (i, c, bc), (_, _, names) in zip(blocks, plan):
+        fns.append(ReplayBlock(params, bc, i, c, prefix, attn_idx))
+        attn_idx += sum(layer.split('-')[0] == "attention" for layer in bc.layer)
+        subsets.append({n: ctx.params[n] for n in names})
+    params.attention_idx = attn_idx
+
+    if strategy == "revnet":
+        x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src)
+        return x1 + x2, plan
+    if strategy == "momentum":
+        x, v = momentum_sequence(tuple(fns), params.momentumnet_alpha,
+                                 tuple(subsets), src, src)
+        return x + v, plan
+    if strategy == "checkpoint":
+        out = src
+        for f, s in zip(fns, subsets):
+            out = jax.checkpoint(f)(s, out)
+        return out, plan
+    # none
+    out = src
+    for f, s in zip(fns, subsets):
+        out = f(s, out)
+    return out, plan

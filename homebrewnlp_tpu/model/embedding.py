@@ -1,0 +1,164 @@
+"""Embedding variants: absolute / axial / relative(-learned) + gather_embed.
+
+Reference: /root/reference/src/model/embedding.py.  The reference implements
+Gather/ScatterAdd as custom slicewise mtf Operations with hand-written
+backward (:39-125); here lookup is a one-hot einsum (MXU-friendly, ideal for
+the char-level vocab=256 configs) or jnp.take_along_axis for large tables
+(PKM's features_per_head^2 values), both with native AD.
+"""
+from __future__ import annotations
+
+import math
+import typing
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import BlockArgs
+from ..core import scope
+from ..core.dims import Dim, SHAPE, shape_size, shape_sub
+from ..core.tensor import (NamedTensor, cast, einsum, multiply, nt, one_hot,
+                           reshape, sin, transpose_to)
+from .backend import normal_var, orthogonal_var
+from .utils import linear_shapes
+
+
+def _embed_var(args: BlockArgs, shape: SHAPE) -> NamedTensor:
+    if "orthogonal" in args.name_extras:
+        return orthogonal_var(args, shape)
+    return normal_var(args, shape, args.params.embedding_stddev)
+
+
+def _relative(args: BlockArgs, shape: typing.List[Dim]) -> NamedTensor:
+    """Sinusoidal relative positions (embedding.py:128-172), reproduced
+    term-for-term including the reference's raw exp(feature_index) frequency
+    formula (only numerically sane for small feature counts; flagship configs
+    use 'absolute')."""
+    params = args.params
+    position_dims = shape_sub(shape_sub(shape, params.feature_dims), params.intermediate)
+    feature_dims = linear_shapes(args).old
+    position_count = shape_size(position_dims)
+    cosine = "cosine" in params.position_embedding
+
+    def multi_dim_range(dims: typing.List[Dim]) -> np.ndarray:
+        out = np.zeros([d.size for d in dims], dtype=np.float32)
+        stride = 1
+        for idx, dim in enumerate(dims):
+            view = [1] * len(dims)
+            view[idx] = dim.size
+            out = out + np.arange(0, dim.size * stride, stride,
+                                  dtype=np.float32).reshape(view)
+            stride *= dim.size
+        return out
+
+    positions = multi_dim_range(position_dims)
+    features = multi_dim_range(feature_dims)
+    additive = 0.0
+    feature_count = float(shape_size(feature_dims))
+    if cosine:
+        additive = np.mod(features, 2)
+        features = (features - additive) / 2
+        additive = additive * math.pi
+        feature_count /= 2
+    features = features + 4 / feature_count
+    features = features - math.log(position_count / 2 / math.pi)
+    features = np.exp(features) + additive
+    out = np.sin(np.multiply.outer(positions, features)) * params.embedding_stddev
+    out_nt = nt(jnp.asarray(out.reshape([d.size for d in position_dims + feature_dims]),
+                            dtype=params.calculation_dtype),
+                position_dims + feature_dims)
+    return transpose_to(out_nt, list(shape))
+
+
+def _embed(args: BlockArgs, shape: SHAPE) -> NamedTensor:
+    shape = list(shape)
+    params = args.params
+    position_dims = shape_sub(shape_sub(shape, params.feature_dims), params.intermediate)
+    feature_dims = linear_shapes(args).old
+
+    if "absolute" in args.name_extras:
+        return _embed_var(args, shape)
+    if "axial" in args.name_extras:
+        splits = 2
+        for a in args:
+            if a.isdigit():
+                splits = int(a)
+                break
+        tmp_dims: typing.List[Dim] = []
+        variables: typing.List[NamedTensor] = []
+
+        def _new_part(size: int):
+            tmp = Dim(f"_{len(tmp_dims)}", size)
+            tmp_dims.append(tmp)
+            variables.append(_embed_var(args, [tmp] + feature_dims))
+
+        for dim in position_dims:
+            base = int(dim.size ** (1 / splits))
+            while dim.size % base != 0:
+                base -= 1
+            final = dim.size // base ** (splits - 1)
+            _new_part(final)
+            for _ in range(1, splits):
+                _new_part(base)
+        out = einsum(variables, tmp_dims + feature_dims)
+        return reshape(out, [d for d in shape if d in position_dims]
+                       + [d for d in shape if d in feature_dims])
+    if "relative" in args.name_extras:
+        out = _relative(args, shape)
+        if "learned" in args.name_extras:
+            out = multiply(out, _embed_var(args, feature_dims))
+        return out
+    raise ValueError("supported embeddings: relative(-learned), absolute, axial")
+
+
+def embed(args: BlockArgs, shape: SHAPE) -> NamedTensor:
+    return scope.scoped("embed", _embed, args, shape)
+
+
+_ONE_HOT_MAX = 4096
+
+
+def batched_gather(embedding: NamedTensor, indices: NamedTensor,
+                   batch_dims: typing.Optional[SHAPE] = None) -> NamedTensor:
+    """out[idx_dims - batch ..., emb_dims[1:] ...] = embedding[idx, ...] with
+    ``batch_dims`` aligned between the index and embedding tensors (the global
+    semantics of the reference's per-slice squeeze trick, embedding.py:50-52,
+    which relied on sharded head dims having per-core size 1)."""
+    batch_dims = [d for d in (batch_dims or [])
+                  if d in indices.dims and d in embedding.dims]
+    table_dim = embedding.dims[0]
+    if not batch_dims:
+        if table_dim.size <= _ONE_HOT_MAX:
+            oh = one_hot(indices, table_dim, dtype=embedding.dtype)
+            return einsum([oh, embedding],
+                          list(indices.dims) + list(embedding.dims[1:]))
+        out_dims = list(indices.dims) + list(embedding.dims[1:])
+        data = jnp.take(embedding.data, indices.data, axis=0)
+        return nt(data, out_dims)
+    # one batched dim is enough for all reference call-sites (heads)
+    b = batch_dims[0]
+    emb = transpose_to(embedding, [b, table_dim] + shape_sub(embedding.dims, [b, table_dim]))
+    idx_rest = shape_sub(indices.dims, [b])
+    idx = transpose_to(indices, [b] + idx_rest)
+    flat_idx = idx.data.reshape(b.size, -1)  # [B, N]
+    emb_flat = emb.data.reshape(b.size, table_dim.size, -1)  # [B, E, F]
+    taken = jnp.take_along_axis(emb_flat, flat_idx[:, :, None], axis=1)  # [B, N, F]
+    rest_emb = shape_sub(emb.dims, [b, table_dim])
+    data = taken.reshape([b.size] + [d.size for d in idx_rest]
+                         + [d.size for d in rest_emb])
+    out = nt(data, [b] + list(idx_rest) + list(rest_emb))
+    # match the reference's output dim order: (indices - squeeze) + emb[1:]
+    ref_order = list(shape_sub(indices.dims, [b])) + list(embedding.dims[1:])
+    return transpose_to(out, ref_order)
+
+
+def gather_embed(args: BlockArgs, shape: SHAPE,
+                 squeezed_dims: typing.Optional[SHAPE] = None,
+                 storage: typing.Optional[dict] = None) -> NamedTensor:
+    embedding = scope.scoped("gather", embed, args, shape)
+    if storage is not None:
+        # the reference stashes the token embedding tensor for the
+        # contrastive loss (model/__init__.py:80, dataclass.py TensorStorage)
+        storage["text_input_embedding"] = embedding
+    out = batched_gather(embedding, args.tensor, squeezed_dims)
+    return cast(out, args.params.calculation_dtype)

@@ -1,0 +1,79 @@
+"""Layer registry + block string DSL (reference: /root/reference/src/model/frontend.py).
+
+Block config strings like
+``"attention-biased_attention_map-absolute-input_as_value-shared"`` are split
+on '-' into the layer name + name_extras flags; ``split_path`` implements the
+';'/',' add/multiply multi-branch DSL (frontend.py:39-55).
+"""
+from __future__ import annotations
+
+from ..config import BlockArgs, BlockConfig, ModelParameter
+from ..core import scope
+from ..core.tensor import NamedTensor, add, multiply
+from .activation import activate
+from .basic import (bottleneck_group_linear, dropout, feed_forward,
+                    feed_forward_product_key_memory, group_linear,
+                    product_key_memory, reduced_half_linear, rezero, sum_heads,
+                    transpose_sequence_features)
+from .normalization import norm
+from .spatial import attention, cummean, cumsum
+
+
+def convolution(args: BlockArgs) -> NamedTensor:
+    # parity with the reference, which disables its conv layer
+    # (/root/reference/src/model/convolution.py:129)
+    raise ValueError("Convolution is currently broken")
+
+
+def _get_block_part(block_part_config: BlockConfig, params: ModelParameter,
+                    block_input: NamedTensor) -> NamedTensor:
+    out = block_input
+    for idx, layer in enumerate(block_part_config.layer, 1):
+        name, *extras = layer.split('-')
+        args = BlockArgs(params, out, extras, idx == len(block_part_config.layer))
+        out = scope.scoped(name + '_', LAYER_FUNCTIONS[name], args)
+    if block_part_config.skip and block_part_config.memory_reduction_strategy in ("none", "checkpoint"):
+        out = out + block_input
+    return out
+
+
+def block_part_fn(params: ModelParameter, block_part_config: BlockConfig,
+                  block_input: NamedTensor, name_prefix: str = 'block') -> NamedTensor:
+    return scope.scoped(f"{name_prefix}_", _get_block_part, block_part_config,
+                        params, block_input)
+
+
+def split_path(args: BlockArgs) -> NamedTensor:
+    """';'-separated parallel branches combined by add/multiply."""
+    base, *name_extras = '-'.join(args.name_extras).split(';')
+    base = base.split('-')
+    if 'add' in base:
+        out, fn = 0, add
+    elif 'multiply' in base:
+        out, fn = 1, multiply
+    else:
+        raise ValueError(f"split_path needs add/multiply base, got {base}")
+    for conf in name_extras:
+        out = fn(out, _get_block_part(BlockConfig({'skip': False, 'layer': conf.split(',')}, ''),
+                                      args.params, args.tensor))
+    return out
+
+
+LAYER_FUNCTIONS = {'feed_forward': feed_forward,
+                   'attention': attention,
+                   'cummean': cummean,
+                   'cumsum': cumsum,
+                   'norm': norm,
+                   'rezero': rezero,
+                   'activation': activate,
+                   'convolution': convolution,
+                   'dropout': dropout,
+                   'group_linear': group_linear,
+                   'split_path': split_path,
+                   'feed_forward_product_key_memory': feed_forward_product_key_memory,
+                   'product_key_memory': product_key_memory,
+                   'reduced_half_linear': reduced_half_linear,
+                   'transpose_sequence_features': transpose_sequence_features,
+                   'bottleneck_group_linear': bottleneck_group_linear,
+                   'sum_heads': sum_heads,
+                   }

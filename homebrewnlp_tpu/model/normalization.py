@@ -1,0 +1,36 @@
+"""Normalization layer (reference: /root/reference/src/model/normalization.py).
+
+Mean-subtract + RMS rescale with optional learned scale/shift.  The 'group'
+flag keeps the head dim out of the normalized axes, giving per-head groupnorm
+over features_per_head only (normalization.py:22-34).
+"""
+from __future__ import annotations
+
+import typing
+
+from ..config import BlockArgs
+from ..core.dims import SHAPE, shape_sub
+from ..core.tensor import (NamedTensor, einsum, reduce_mean, rsqrt_eps, square)
+from .backend import normal_var
+from .utils import linear_shapes
+
+
+def norm(args: BlockArgs, feature_shape: typing.Optional[SHAPE] = None) -> NamedTensor:
+    params = args.params
+    block_input = args.tensor
+    if feature_shape is None:
+        feature_shape = linear_shapes(args).old
+    feature_shape = list(feature_shape)
+    reduced = feature_shape if "group" not in args.name_extras else \
+        shape_sub(feature_shape, params.head_dim)
+    normalized_shape = shape_sub(block_input.dims, reduced)
+
+    block_input = block_input - reduce_mean(block_input, output_shape=normalized_shape)
+    scale = [rsqrt_eps(reduce_mean(square(block_input), output_shape=normalized_shape), 1e-5),
+             block_input]
+    if "scale" in args.name_extras:
+        scale.append(normal_var(args, feature_shape, mean=1))
+    block_input = einsum(scale, output_shape=block_input.dims)
+    if "shift" in args.name_extras:
+        block_input = block_input + normal_var(args, feature_shape, mean=0)
+    return block_input

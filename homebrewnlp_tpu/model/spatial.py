@@ -1,0 +1,86 @@
+"""Attention & spatial mixing (reference: /root/reference/src/model/spatial.py).
+
+Generic attention over the "current" attention dim — round-robin over all
+non-feature axes (multi-axis time/height/width attention for video).  Flags:
+dot_product, embedded/positional/context keys, biased_softmax,
+biased_attention_map, scale_attention_map, input_as_value, shared_key_value.
+Causal masking via compare_range + -2e38 bias on dims listed in
+masked_attention_dimensions.  cumsum/cummean are linear-time token mixers
+(native AD replaces the reference's hand-written cumsum gradient).
+"""
+from __future__ import annotations
+
+import typing
+
+from ..config import BlockArgs
+from ..core.dims import Dim, shape_sub
+from ..core.tensor import (NamedTensor, cumsum as tensor_cumsum, einsum, exp,
+                           less, multiply, range_, reduce_max, reduce_sum,
+                           stop_gradient, greater_equal)
+from .basic import activated_linear_in, activated_linear_out
+from .embedding import embed
+from .utils import (anonymize, anonymize_dim, compare_range, get_attention_dim,
+                    is_masked, linear_shapes)
+
+
+def _masked_map(args: BlockArgs) -> typing.Tuple[NamedTensor, typing.Union[NamedTensor, int]]:
+    dim = get_attention_dim(args).dim
+    tmp = anonymize_dim(dim)
+    bias = embed(args, [args.params.head_dim, dim, tmp])
+    return bias, (compare_range(args.params, dim, tmp, greater_equal)
+                  if is_masked(args) else 1)
+
+
+def cumsum(args: BlockArgs) -> NamedTensor:
+    return tensor_cumsum(args.tensor, get_attention_dim(args).dim)
+
+
+def cummean(args: BlockArgs) -> NamedTensor:
+    dim = get_attention_dim(args).dim
+    return cumsum(args) / (1 + range_(dim, args.tensor.dtype))
+
+
+def attention(args: BlockArgs) -> NamedTensor:
+    params = args.params
+    params.attention_idx += 1
+    base = None
+    if "dot_product" in args.name_extras or "input_as_value" not in args.name_extras:
+        base = args(activated_linear_in(args))
+
+    dim = get_attention_dim(args).dim
+    tmp = anonymize_dim(dim)
+    shape = list(args.tensor.dims)
+
+    logit: typing.Union[NamedTensor, int] = 0
+    val: typing.Union[NamedTensor, int] = 0
+    key: typing.Union[NamedTensor, int] = 0
+    if "dot_product" in args.name_extras:
+        if "embedded" in args.name_extras or "context" in args.name_extras:
+            key = activated_linear_out(base)
+        if "embedded" in args.name_extras or "positional" in args.name_extras:
+            key = key + embed(args, [dim] + list(params.feature_dims)) if \
+                isinstance(key, NamedTensor) else embed(args, [dim] + list(params.feature_dims))
+        qry = activated_linear_out(base)
+        qry = qry * dim.size ** -0.5
+        logit_shape = shape_sub(shape, shape_sub(linear_shapes(args).old,
+                                                 [params.head_dim])) + [tmp]
+        logit = einsum([qry, anonymize(key, dim)], output_shape=logit_shape)
+        if "shared_key_value" in args.name_extras:
+            val = key
+    if "biased_softmax" in args.name_extras:
+        logit = logit + multiply(*_masked_map(args))
+    if isinstance(logit, NamedTensor):
+        logit = logit + (compare_range(params, dim, tmp, less) * 1e38) * -2
+        logit = logit - stop_gradient(reduce_max(logit, reduced_dim=tmp))
+        logit = exp(logit)
+        logit = logit / reduce_sum(logit, reduced_dim=tmp)
+    if "biased_attention_map" in args.name_extras:
+        logit = logit + multiply(*_masked_map(args))
+    if "scale_attention_map" in args.name_extras:
+        logit = logit * multiply(*_masked_map(args))
+    if not isinstance(val, NamedTensor):
+        val = anonymize(args.tensor if "input_as_value" in args.name_extras
+                        else activated_linear_out(base), dim)
+    if not isinstance(logit, NamedTensor):
+        raise UserWarning(f"no spatial mixing with attention parameters: {args.name_extras}")
+    return einsum([logit, val], shape)

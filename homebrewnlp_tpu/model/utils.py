@@ -1,0 +1,101 @@
+"""Shape-algebra helpers for the layer DSL.
+
+jax-native analogues of /root/reference/src/utils_mtf.py.  The reference's
+``anonymize`` physically reshaped tensors onto replicated dims so mtf could do
+cross-shard ops; here an anonymized dim is only a *name* change (``seq`` ->
+``_seq``) so that einsum treats query/key positions as distinct axes and the
+sharding layer replicates it (layout rules never match ``_``-prefixed names).
+No data movement — GSPMD inserts any needed collective.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+
+from ..config import BlockArgs, ModelParameter
+from ..core.dims import (Dim, SHAPE, anonymize_dim, deduplicate, dim_name,
+                         has_dim, shape_crossection, shape_sub)
+from ..core.tensor import NamedTensor, cast, greater_equal, range_, rename_dim
+
+ATTENTION_DIM = typing.NamedTuple("AttentionDim", (("index", int), ("dim", Dim)))
+LINEAR_SHAPES = typing.NamedTuple("LinearShapes", (("old", list), ("new", list)))
+
+
+def anonymize(tensor: NamedTensor, dim: typing.Union[Dim, str]) -> NamedTensor:
+    """Rename dim -> _dim (replicated under layout rules).
+    Reference: src/utils_mtf.py:207-232 — there a reshape, here a no-op rename."""
+    name = dim_name(dim)
+    if not has_dim(tensor.dims, name):
+        return tensor
+    return rename_dim(tensor, name, "_" + name.lstrip("_") if not name.startswith("_") else name)
+
+
+def unanonymize(tensor: NamedTensor, dim: typing.Union[Dim, str]) -> NamedTensor:
+    name = dim_name(dim)
+    anon = "_" + name.lstrip("_")
+    if not has_dim(tensor.dims, anon):
+        return tensor
+    return rename_dim(tensor, anon, name.lstrip("_"))
+
+
+def anonymize_shape(dims: SHAPE, dim: Dim,
+                    size: typing.Optional[int] = None) -> typing.List[Dim]:
+    """Copy of dims with `dim` anonymized (src/utils_mtf.py anonymize_shape)."""
+    return [anonymize_dim(d, size) if d == dim else d for d in dims]
+
+
+def get_intermediate(args: BlockArgs) -> typing.List[Dim]:
+    if "group" not in args.name_extras:
+        return list(args.params.intermediate)
+    return [args.params.head_dim,
+            anonymize_dim(args.params.key_dim,
+                          args.params.key_dim.size * args.params.group_linear_factor)]
+
+
+def linear_shapes(args: BlockArgs) -> LINEAR_SHAPES:
+    """Infer (old, new) einsum dims from tensor shape ∩ feature dims
+    (reference: src/utils_mtf.py:383-391)."""
+    params = args.params
+    features = get_intermediate(args) + list(params.feature_dims)
+    if "group" in args.name_extras and has_dim(args.tensor.dims, params.intermediate[-1]):
+        features = [d for d in features if d != params.key_dim]
+        features.extend(params.intermediate)
+    features = deduplicate(features)
+    old = shape_crossection(args.tensor.dims, features)
+    drop = [params.head_dim] if ("group" in args.name_extras and params.head_dim in old) else []
+    new = [d for d in features if d not in shape_sub(old, drop)]
+    return LINEAR_SHAPES(list(old), list(new))
+
+
+def feature_dims_used(params: ModelParameter, shape: SHAPE,
+                      dims: typing.Optional[SHAPE] = None) -> bool:
+    if isinstance(shape, NamedTensor):
+        shape = shape.dims
+    if dims is None:
+        dims = list(params.feature_dims) + [anonymize_dim(d) for d in params.feature_dims]
+        return bool(sum(f in list(shape) for f in dims) // 2)
+    return all(f in list(shape) for f in dims)
+
+
+def compare_range(params: ModelParameter, dim0: Dim, dim1: Dim,
+                  comparison) -> NamedTensor:
+    """comparison(range(dim0), range(dim1)) as activation dtype — causal masks
+    (reference: src/utils_mtf.py:411-415)."""
+    return cast(comparison(range_(dim0, jnp.int32), range_(dim1, jnp.int32)),
+                params.calculation_dtype)
+
+
+def get_attention_dim(args: BlockArgs) -> ATTENTION_DIM:
+    """Round-robin choice of the mixing axis (src/utils_mtf.py:418-422):
+    cycles over all non-feature dims after batch, enabling factorized
+    multi-axis (time/height/width) attention for video."""
+    params = args.params
+    attention_dims = [d for d in args.tensor.dims
+                      if d not in params.feature_dims and d not in params.intermediate][1:]
+    idx = params.attention_idx % len(attention_dims)
+    return ATTENTION_DIM(idx, attention_dims[idx])
+
+
+def is_masked(args: BlockArgs) -> bool:
+    return get_attention_dim(args).index in args.params.masked_attention_dimensions
