@@ -50,6 +50,9 @@ class Context:
         self.rng_key = rng_key
         self.stack: typing.List[_Frame] = [_Frame("")]
         self.touched: typing.Optional[typing.List[str]] = [] if record_touched else None
+        # name -> tuple[Dim] recorded at init; consumed by the optimizer's
+        # shape-based heuristics and the sharding planner
+        self.param_dims: typing.Dict[str, tuple] = {}
         # arbitrary cross-layer caches (shared-variable machinery etc.)
         self.cache: typing.Dict[str, typing.Any] = {}
         self._rng_count = 0
@@ -150,6 +153,7 @@ def get_param(name_leaf: str, dims, initializer, slice_dtype, calc_dtype
         # device placement + sharding happen at train setup, so init never
         # touches an accelerator.
         ctx.params[name] = value.astype(slice_dtype)
+        ctx.param_dims[name] = dims
     if name not in ctx.params:
         raise KeyError(f"parameter {name} missing from provided params")
     if ctx.touched is not None and name not in ctx.touched:

@@ -1,0 +1,108 @@
+"""Mesh construction + named-dim -> PartitionSpec layout rules.
+
+The TPU-native replacement for the reference's auto-derived mtf mesh
+(`mesh_shape = "b:<tpu_size/heads>,h:<heads>"`, `layout = "batch:b,heads:h"`,
+/root/reference/src/dataclass.py:247-252) and SimdMeshImpl lowering: dim
+*names* map to mesh axes; anonymized (``_``-prefixed) dims never match a rule
+and are therefore replicated, exactly like the reference's anonymize trick —
+but here XLA GSPMD materialises the collectives.
+
+Axes: 'data' (batch), 'model' (heads), optional 'sequence' (long-context
+sequence sharding — new capability, reference has none, SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import ModelParameter
+from .dims import Dim
+from .tensor import NamedTensor, nt
+
+
+def build_mesh(params: ModelParameter,
+               devices: typing.Optional[typing.Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh from the config's derived mesh_shape, adapted to the devices
+    actually present (the config targets a pod; tests run on 8 virtual CPU
+    devices; bench runs on 1 chip)."""
+    if devices is None:
+        devices = jax.devices()
+    ndev = len(devices)
+    shape = dict(params.mesh_shape)
+    model = shape.get("model", 1)
+    seq = shape.get("sequence", 1)
+    while model * seq > ndev and model > 1:
+        model //= 2
+    while model * seq > ndev and seq > 1:
+        seq //= 2
+    data = max(1, ndev // (model * seq))
+    axes, sizes = [], []
+    for name, size in (("data", data), ("model", model), ("sequence", seq)):
+        if name in shape or name == "data":
+            axes.append(name)
+            sizes.append(size if name != "data" else data)
+    dev_array = np.asarray(devices[: int(np.prod(sizes))]).reshape(sizes)
+    return Mesh(dev_array, tuple(axes))
+
+
+def spec_for_dims(params: ModelParameter, dims: typing.Sequence[Dim],
+                  mesh: Mesh) -> PartitionSpec:
+    """PartitionSpec from layout rules; each mesh axis used at most once."""
+    used: set = set()
+    entries = []
+    for d in dims:
+        axis = params.layout.get(d.name)
+        if axis is not None and axis in mesh.axis_names and axis not in used \
+                and d.size % mesh.shape[axis] == 0:
+            entries.append(axis)
+            used.add(axis)
+        else:
+            entries.append(None)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def named_sharding(params: ModelParameter, dims: typing.Sequence[Dim],
+                   mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, spec_for_dims(params, dims, mesh))
+
+
+def shard_params(params: ModelParameter, variables: typing.Dict[str, jax.Array],
+                 param_dims: typing.Dict[str, tuple], mesh: Mesh
+                 ) -> typing.Dict[str, jax.Array]:
+    """device_put every variable with its layout-derived NamedSharding
+    (weights carrying a 'heads' dim shard over 'model', like mtf layout
+    rules sharded every heads-bearing weight)."""
+    out = {}
+    for name, value in variables.items():
+        dims = param_dims.get(name, ())
+        sharding = named_sharding(params, dims, mesh)
+        out[name] = jax.device_put(value, sharding)
+    return out
+
+
+def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
+                mesh: Mesh) -> typing.Dict[str, jax.Array]:
+    """Batch arrays shard along their leading (batch) axis over 'data'."""
+    out = {}
+    for key, value in batch.items():
+        entries: typing.List[typing.Optional[str]] = [None] * value.ndim
+        if "data" in mesh.axis_names and value.ndim and \
+                value.shape[0] % mesh.shape["data"] == 0:
+            entries[0] = "data"
+        out[key] = jax.device_put(value, NamedSharding(mesh, PartitionSpec(*entries)))
+    return out
+
+
+def with_constraint(t: NamedTensor, params: ModelParameter,
+                    mesh: typing.Optional[Mesh]) -> NamedTensor:
+    """Annotate a named tensor's sharding inside jit (activation layouts)."""
+    if mesh is None:
+        return t
+    spec = spec_for_dims(params, t.dims, mesh)
+    return nt(jax.lax.with_sharding_constraint(t.data, NamedSharding(mesh, spec)),
+              t.dims)

@@ -239,6 +239,7 @@ class Model:
     def __init__(self, params: ModelParameter):
         self.params = params
         self.plan: typing.Optional[typing.Tuple[BlockSpec, ...]] = None
+        self.param_dims: typing.Dict[str, tuple] = {}
 
     def _named_inputs(self, batch: typing.Dict[str, jax.Array]):
         p = self.params
@@ -276,6 +277,7 @@ class Model:
 
         jax.eval_shape(_run, {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                               for k, v in batch.items() if v is not None})
+        self.param_dims = dict(ctx.param_dims)
         return ctx.params
 
     def apply(self, variables: typing.Dict[str, jax.Array],

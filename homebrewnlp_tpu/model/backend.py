@@ -90,6 +90,7 @@ def get_var(args: BlockArgs, shape: SHAPE, initializer) -> NamedTensor:
         value = np.asarray(initializer(scope.name_seed(canonical, ctx.seed), sizes),
                            dtype=np.float32)
         ctx.params[canonical] = value.astype(params.slice_dtype)
+        ctx.param_dims[canonical] = tuple(shape)
     if canonical not in ctx.params:
         raise KeyError(f"shared parameter {canonical} missing")
     if ctx.touched is not None and canonical not in ctx.touched:

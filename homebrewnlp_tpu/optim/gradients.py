@@ -1,0 +1,74 @@
+"""Multi-loss gradient surgery: pcgrad and mgda.
+
+Reference: /root/reference/src/optimizer/gradients.py (hooked into the manual
+backward sweep) and the MGDA gamma solve in src/optimizer/__init__.py:110-126.
+Here per-loss gradients come from separate ``jax.grad`` calls and are combined
+functionally.  Both strategies only touch 'body' variables, like the
+reference.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+Params = typing.Dict[str, jax.Array]
+
+
+def _is_body(name: str) -> bool:
+    return "body" in name
+
+
+def pcgrad_combine(grads_per_loss: typing.List[Params]) -> Params:
+    """Project conflicting gradients (PCGrad) for body vars; linear sum
+    elsewhere (gradients.py:11-35)."""
+    out: Params = {}
+    for name in grads_per_loss[0]:
+        gs = [g[name] for g in grads_per_loss]
+        if not _is_body(name) or len(gs) == 1:
+            out[name] = sum(gs[1:], gs[0])
+            continue
+        all_grads = list(gs)
+        g_square = [1e-8 + jnp.sum(g * g) for g in all_grads[1:]]
+        for _ in range(len(all_grads)):
+            grad = all_grads.pop(0)
+            for g, sq in zip(all_grads, g_square):
+                grad = grad - g * jnp.minimum(jnp.sum(grad * g), 0) * sq
+            all_grads.append(grad)
+            g_square.append(jnp.sum(g * g))
+        out[name] = sum(all_grads[1:], all_grads[0])
+    return out
+
+
+def mgda_gamma(grads_per_loss: typing.List[Params]) -> jax.Array:
+    """Min-norm two-loss gamma (reference __init__.py:110-126)."""
+    assert len(grads_per_loss) >= 2
+    v1v1 = v1v2 = v2v2 = 0.
+    for name in grads_per_loss[0]:
+        if not _is_body(name):
+            continue
+        g1 = grads_per_loss[0][name].astype(jnp.float32)
+        g2 = grads_per_loss[1][name].astype(jnp.float32)
+        v1v1 = v1v1 + jnp.sum(g1 * g1)
+        v1v2 = v1v2 + jnp.sum(g1 * g2)
+        v2v2 = v2v2 + jnp.sum(g2 * g2)
+    min_gamma = 0.001
+    gamma = (1 - min_gamma) * (v1v2 >= v1v1).astype(jnp.float32)
+    gamma = gamma + min_gamma * (v1v2 >= v2v2).astype(jnp.float32) * (gamma == 0)
+    gamma = gamma + (-1.) * (gamma == 0) * (v1v2 - v2v2) / (v1v1 + v2v2 - 2 * v1v2)
+    return gamma
+
+
+def mgda_combine(grads_per_loss: typing.List[Params]) -> Params:
+    gamma = mgda_gamma(grads_per_loss)
+    out: Params = {}
+    for name in grads_per_loss[0]:
+        g1 = grads_per_loss[0][name]
+        g2 = grads_per_loss[1][name]
+        out[name] = (g1.astype(jnp.float32) * gamma
+                     + g2.astype(jnp.float32) * (1 - gamma)).astype(g1.dtype)
+    return out
+
+
+MULTI_LOSS_GRADIENTS = {"pcgrad": pcgrad_combine, "mgda": mgda_combine}

@@ -1,0 +1,56 @@
+"""LR schedule DSL (reference: /root/reference/src/optimizer/learning_rate.py).
+
+``learning_rate_config`` is a dict of named modules applied in order:
+linear_warmup, exponential_decay, linear_decay, lower_bound, upper_bound,
+each a LearningRateConfig(start_step, final_step, factor).  The reference
+computes this host-side in TF and imports it replicated; here it is a pure
+jnp function of the global step, traced into the train step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import LearningRateConfig, ModelParameter
+
+
+def _linear_warmup(lr, step, cfg: LearningRateConfig):
+    warmup = jnp.float32(cfg.final_step)
+    is_warmup = (step < warmup).astype(jnp.float32)
+    factor = is_warmup * (step / warmup) + (1 - is_warmup)
+    return lr * factor
+
+
+def _exponential_decay(lr, step, cfg: LearningRateConfig):
+    exp = jnp.maximum(step - jnp.float32(cfg.start_step), 0.)
+    return lr * jnp.float32(cfg.factor) ** exp
+
+
+def _linear_decay(lr, step, cfg: LearningRateConfig):
+    start = jnp.float32(cfg.start_step)
+    final = jnp.float32(cfg.final_step) - start
+    decay = 1 - (step - start) / final
+    return lr * jnp.clip(decay, 0., 1.)
+
+
+def _lower_bound(lr, step, cfg: LearningRateConfig):
+    return jnp.maximum(lr, jnp.float32(cfg.factor))
+
+
+def _upper_bound(lr, step, cfg: LearningRateConfig):
+    return jnp.minimum(lr, jnp.float32(cfg.factor))
+
+
+MODULES = {"linear_warmup": _linear_warmup,
+           "exponential_decay": _exponential_decay,
+           "linear_decay": _linear_decay,
+           "lower_bound": _lower_bound,
+           "upper_bound": _upper_bound}
+
+
+def get_learning_rate(params: ModelParameter, global_step) -> jnp.ndarray:
+    """f32 scalar learning rate at ``global_step``."""
+    step = jnp.asarray(global_step, jnp.float32)
+    lr = jnp.float32(params.learning_rate)
+    for name, cfg in params.learning_rate_config.items():
+        lr = MODULES[name](lr, step, cfg)
+    return lr

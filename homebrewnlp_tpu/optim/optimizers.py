@@ -1,0 +1,176 @@
+"""Optimizer-chain members (reference: /root/reference/src/optimizer/optimizers.py).
+
+The config string ``optimizer`` is a '-'-chain with ':'-args, e.g.
+``"adaptive_clip:0.003-sm3-momentum:0.9:1:1-learning_rate"``, folded left over
+the gradient.  Each member is a pure function (ctx, *args) -> transformed
+gradient; stateful members read/write named slots in ``ctx.slots`` (the
+jax-native replacement for the reference's per-variable slot variables named
+``{var}/{optimizer}/{slot}``, src/optimizer/backend.py:23-25).
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+@dataclasses.dataclass
+class VarCtx:
+    """Per-variable context flowing through the chain
+    (reference: src/optimizer/context.py)."""
+    name: str
+    grad: Array                      # in optimizer_calculation_dtype
+    value: Array                     # current weight, optimizer_calculation_dtype
+    slots: typing.Dict[str, Array]   # state in (read: prev, write: new)
+    new_slots: typing.Dict[str, Array]
+    learning_rate: Array
+    beta1: Array
+    beta2: Array
+    step_count: Array                # global_step + 1 (debias exponent)
+    global_norm_reciprocal: typing.Optional[Array] = None
+    slot_dtype: typing.Any = jnp.float32
+
+    def get_slot(self, opt: str, slot: str, shape) -> Array:
+        key = f"{opt}/{slot}"
+        if key in self.slots:
+            return self.slots[key].astype(self.grad.dtype)
+        return jnp.zeros(shape, self.grad.dtype)
+
+    def set_slot(self, opt: str, slot: str, value: Array):
+        self.new_slots[f"{opt}/{slot}"] = value.astype(self.slot_dtype)
+
+
+def _opt_rsqrt(x: Array) -> Array:
+    return 1.0 / jnp.maximum(jnp.sqrt(x), 1e-5)
+
+
+def _debias_momentum(ctx: VarCtx, momentum: Array) -> Array:
+    return 1.0 / (1.0 - momentum ** ctx.step_count)
+
+
+def adam(ctx: VarCtx) -> Array:
+    p2 = ctx.get_slot("adam", "exp_avg_p2", ctx.grad.shape)
+    p1 = ctx.get_slot("adam", "exp_avg_p1", ctx.grad.shape)
+    p2 = p2 * ctx.beta2 + jnp.square(ctx.grad) * (1 - ctx.beta2)
+    p1 = p1 * ctx.beta1 + ctx.grad * (1 - ctx.beta1)
+    ctx.set_slot("adam", "exp_avg_p2", p2)
+    ctx.set_slot("adam", "exp_avg_p1", p1)
+    return _opt_rsqrt(p2 * _debias_momentum(ctx, ctx.beta2)) * p1 \
+        * _debias_momentum(ctx, ctx.beta1)
+
+
+def novograd(ctx: VarCtx) -> Array:
+    if ctx.grad.ndim == 0:
+        return adam(ctx)
+    p1 = ctx.get_slot("novograd", "exp_avg_p1", ctx.grad.shape)
+    p2 = ctx.get_slot("novograd", "exp_avg_p2", ())
+    p1 = ctx.beta1 * p1 + ctx.grad * _opt_rsqrt(p2)
+    p2 = p2 * ctx.beta2 + jnp.sum(jnp.square(ctx.grad)) * (1 - ctx.beta2)
+    ctx.set_slot("novograd", "exp_avg_p1", p1)
+    ctx.set_slot("novograd", "exp_avg_p2", p2)
+    return ctx.beta1 * p1 + ctx.grad * _opt_rsqrt(p2 * _debias_momentum(ctx, ctx.beta2))
+
+
+def sm3(ctx: VarCtx) -> Array:
+    """SM3 with per-dim min-bucket accumulators (optimizers.py:60-76)."""
+    if ctx.grad.ndim == 0:
+        return adam(ctx)
+    shape = ctx.grad.shape
+    bufs = []
+    acc = None
+    for i in range(ctx.grad.ndim):
+        view = [1] * ctx.grad.ndim
+        view[i] = shape[i]
+        buf = ctx.get_slot("sm3", f"dim{i}", (shape[i],)).reshape(view)
+        bufs.append(buf)
+        acc = buf if acc is None else jnp.minimum(acc, buf)
+    acc = acc + jnp.square(ctx.grad)
+    for i in range(ctx.grad.ndim):
+        axes = tuple(a for a in range(ctx.grad.ndim) if a != i)
+        ctx.set_slot("sm3", f"dim{i}", jnp.max(acc, axis=axes))
+    return ctx.grad * _opt_rsqrt(acc)
+
+
+def adaptive_clip(ctx: VarCtx, gradient_clip: str) -> Array:
+    """AGC (optimizers.py:79-84): g * min(||w|| * clip / ||g||, 1)."""
+    clip = float(gradient_clip)
+    grd_norm_recip = jnp.minimum(jax_rsqrt(jnp.sum(jnp.square(ctx.grad))), 1e6)
+    wgt_norm = jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(ctx.value))), 1e-3)
+    return ctx.grad * jnp.minimum(wgt_norm * grd_norm_recip * clip, 1)
+
+
+def jax_rsqrt(x: Array) -> Array:
+    import jax.lax
+    return jax.lax.rsqrt(x)
+
+
+def l2norm_clip(ctx: VarCtx, gradient_clip: str) -> Array:
+    clip = float(gradient_clip)
+    return ctx.grad * clip * jax_rsqrt(jnp.maximum(jnp.sum(jnp.square(ctx.grad)),
+                                                   clip ** -2))
+
+
+def global_l2norm_clip(ctx: VarCtx, gradient_clip: str) -> Array:
+    clip = float(gradient_clip)
+    assert ctx.global_norm_reciprocal is not None, \
+        "chain driver must precompute the global norm"
+    return ctx.grad * clip * ctx.global_norm_reciprocal
+
+
+def value_clip(ctx: VarCtx, gradient_clip: str) -> Array:
+    clip = float(gradient_clip)
+    return jnp.clip(ctx.grad, -clip, clip)
+
+
+def gradient_centralisation(ctx: VarCtx) -> Array:
+    return ctx.grad - jnp.mean(ctx.grad)
+
+
+def weight_centralisation(ctx: VarCtx) -> Array:
+    return ctx.grad + jnp.mean(ctx.value)
+
+
+def multiply_learning_rate(ctx: VarCtx) -> Array:
+    return ctx.grad * ctx.learning_rate
+
+
+def momentum(ctx: VarCtx, momentum_multiplier: str, gradient_multiplier: str,
+             nesterov: str) -> Array:
+    nesterov_b = bool(int(nesterov))
+    mm = float(momentum_multiplier)
+    gm = float(gradient_multiplier)
+    state = ctx.get_slot("momentum", "momentum", ctx.grad.shape)
+    new_state = mm * state + ctx.grad * gm
+    ctx.set_slot("momentum", "momentum", new_state)
+    if not nesterov_b:
+        return new_state
+    return ctx.grad + mm * new_state
+
+
+OPTIMIZERS: typing.Dict[str, typing.Callable] = {
+    "adam": adam,
+    "sm3": sm3,
+    "novograd": novograd,
+    "adaptive_clip": adaptive_clip,
+    "l2norm_clip": l2norm_clip,
+    "value_clip": value_clip,
+    "gradient_centralisation": gradient_centralisation,
+    "weight_centralisation": weight_centralisation,
+    "learning_rate": multiply_learning_rate,
+    "global_l2norm_clip": global_l2norm_clip,
+    "momentum": momentum,
+}
+
+
+def graft(ctx: VarCtx, optimizer: str, *args: str) -> Array:
+    """Norm-grafting: direction of g, magnitude of the grafted optimizer
+    (optimizers.py:145-151)."""
+    other = OPTIMIZERS[optimizer](ctx, *args)
+    return (ctx.grad * jax_rsqrt(jnp.sum(jnp.square(ctx.grad)))
+            * jnp.sqrt(jnp.sum(jnp.square(other))))
+
+
+OPTIMIZERS["graft"] = graft
