@@ -152,10 +152,14 @@ def einsum(inputs: typing.Sequence[NT], output_shape: SHAPE) -> NT:
     in_specs = ",".join("".join(sym[d] for d in t.dims) for t in inputs)
     out_spec = "".join(sym[d] for d in output_shape)
     dtype = jnp.result_type(*[t.dtype for t in inputs])
+    # bf16 matmuls accumulate in f32 on the MXU; CPU's DotThunk can't emit
+    # mixed bf16->f32 dots, so only request it on TPU backends
+    prefer = None
+    if dtype == jnp.bfloat16 and jax.default_backend() not in ("cpu",):
+        prefer = jnp.float32
     data = jnp.einsum(f"{in_specs}->{out_spec}",
                       *[t.data for t in inputs],
-                      preferred_element_type=jnp.promote_types(dtype, jnp.float32)
-                      if dtype == jnp.bfloat16 else None)
+                      preferred_element_type=prefer)
     return nt(data.astype(dtype), output_shape)
 
 

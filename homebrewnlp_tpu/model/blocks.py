@@ -140,9 +140,9 @@ def _mom_bwd(fns, alpha, res, cot):
         x_prev = x - v
         fval, fvjp = jax.vjp(f, s, x_prev)
         v_prev = (v - fval * (1 - alpha)) / alpha
-        g = dx + dv  # cotangent on v' (feeds both outputs)
-        ds, dx_f = fvjp(g)
-        dx_prev = dx + dx_f * (1 - alpha)
+        g = dx + dv  # total cotangent on v' (it feeds both outputs)
+        ds, dx_f = fvjp(g * (1 - alpha))  # f enters v' scaled by (1 - alpha)
+        dx_prev = dx + dx_f
         dv_prev = g * alpha
         x, v = x_prev, v_prev
         dx, dv = dx_prev, dv_prev

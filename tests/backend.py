@@ -1,0 +1,77 @@
+"""Shared test harness.
+
+Mirrors the reference's statistical-test style
+(/root/reference/tests/backend.py): layers are exercised on random input and
+asserted on distributional properties (mean/std), not golden values, across
+dtype grids.  RELU_STD and the size-scaled tolerance formula come from
+tests/backend.py:13,71-73 of the reference.
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from homebrewnlp_tpu.config import BlockArgs, ModelParameter
+from homebrewnlp_tpu.core import scope
+from homebrewnlp_tpu.core.dims import Dim
+from homebrewnlp_tpu.core.tensor import NamedTensor, nt
+
+RELU_STD = 1 / 1.42
+
+MIXER_BLOCKS = [
+    {'layer': ['norm-shift-scale-features-group',
+               'bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:shift-mid:scale-mid:features']},
+    {'layer': ['norm-shift-scale-features-group',
+               'attention-biased_attention_map-absolute-input_as_value-shared',
+               'norm-shift-scale-features-group', 'activation-gelu',
+               'attention-biased_attention_map-absolute-input_as_value-shared']}]
+
+
+def make_params(**kwargs) -> ModelParameter:
+    cfg = {'model_mode': 'gpt', 'use_video': False, 'use_language': True,
+           'sequence_length': 16, 'features_per_head': 16, 'heads': 2,
+           'depth': 2, 'train_batch_size': 4, 'vocab_size': 32,
+           'group_linear_factor': 2,
+           'intermediate_feed_forward_multiplier_multiplier': 0.5,
+           'block_config': MIXER_BLOCKS,
+           'memory_reduction_strategy': 'none'}
+    cfg.update(kwargs)
+    return ModelParameter(cfg)
+
+
+def tolerance(params: ModelParameter) -> float:
+    fp16 = any("16" in str(d) for d in (params.calculation_dtype,
+                                        params.slice_dtype, params.storage_dtype))
+    return 1 / (params.train_batch_size * params.sequence_length
+                * params.features) ** (0.05 if fp16 else 1 / 3)
+
+
+class OpHarness:
+    """Build one layer fn on a standard random input and inspect the output,
+    creating parameters through a real init context."""
+
+    def __init__(self, params: ModelParameter, extras: typing.Optional[list] = None,
+                 seed: int = 0):
+        self.params = params
+        self.extras = [''] if extras is None else extras
+        self.rng = np.random.default_rng(seed)
+
+    def input_tensor(self) -> NamedTensor:
+        p = self.params
+        dims = [p.batch_dim, p.sequence_dim] + list(p.feature_dims)
+        data = self.rng.standard_normal([d.size for d in dims]).astype(np.float32)
+        return nt(data.astype(p.calculation_dtype), dims)
+
+    def run(self, fn, *args, **kwargs):
+        ctx = scope.Context("init", seed=0)
+        with scope.context(ctx):
+            out = fn(*args, **kwargs)
+        self.ctx = ctx
+        return out
+
+    def run_layer(self, layer_fn) -> np.ndarray:
+        inp = self.input_tensor()
+        args = BlockArgs(self.params, inp, list(self.extras))
+        out = self.run(layer_fn, args)
+        return np.asarray(out.data, dtype=np.float32)

@@ -1,0 +1,22 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax initialises.
+
+Mirrors the reference's PlacementMeshImpl-on-cpu:0 test harness
+(/root/reference/tests/backend.py:45-59) but with a real 8-device mesh so
+NamedSharding layouts and collectives are exercised (SURVEY.md §4 notes the
+reference never tests multi-core behavior; we do).
+"""
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # make any jax re-init skip the axon TPU
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

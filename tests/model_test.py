@@ -1,0 +1,165 @@
+"""Full-model tests: forward/backward across memory strategies, revnet /
+momentumnet custom-vjp gradient correctness vs direct autodiff, macro-batch
+equivalence.  (The reference has no such tests — SURVEY.md §4 calls out the
+gap; these protect the trickiest machinery we have.)
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from backend import make_params
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.model.blocks import momentum_sequence, rev_sequence
+
+
+def _batch(rng, params):
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    return {'token_x': jnp.asarray(x),
+            'token_y': jnp.asarray((x + 1) % params.vocab_size)}
+
+
+@pytest.mark.parametrize("strategy", ["none", "checkpoint", "revnet", "momentum"])
+def forward_backward_test(strategy):
+    params = make_params(memory_reduction_strategy=strategy)
+    m = Model(params)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, params)
+    variables = m.init(batch)
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda v: m.apply(v, batch).total_loss.data))(variables)
+    assert np.isfinite(float(loss))
+    for k, g in grads.items():
+        assert np.all(np.isfinite(np.asarray(g, np.float32))), k
+    # at least one non-zero gradient per block
+    gnorm = sum(float(jnp.sum(g.astype(jnp.float32) ** 2)) for g in grads.values())
+    assert gnorm > 0
+
+
+def checkpoint_matches_none_test():
+    """Gradient checkpointing must be bit-identical to plain backprop."""
+    grads = {}
+    for strategy in ("none", "checkpoint"):
+        rng = np.random.default_rng(0)
+        params = make_params(memory_reduction_strategy=strategy)
+        m = Model(params)
+        batch = _batch(rng, params)
+        variables = m.init(batch)
+        _, g = jax.jit(jax.value_and_grad(
+            lambda v: m.apply(v, batch).total_loss.data))(variables)
+        grads[strategy] = g
+    for k in grads["none"]:
+        np.testing.assert_allclose(np.asarray(grads["none"][k], np.float32),
+                                   np.asarray(grads["checkpoint"][k], np.float32),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _toy_fns(n, width, key):
+    """Simple parameterised blocks y = tanh(x @ W) for sequence tests."""
+    keys = jax.random.split(key, n)
+    subsets = tuple({"w": jax.random.normal(k, (width, width)) * 0.3} for k in keys)
+
+    def mk(i):
+        def f(subset, x):
+            return jnp.tanh(x @ subset["w"])
+        return f
+    return tuple(mk(i) for i in range(n)), subsets
+
+
+def rev_sequence_grad_test():
+    """custom-vjp reversible stack == direct autodiff of the same recurrence."""
+    key = jax.random.PRNGKey(0)
+    fns, subsets = _toy_fns(4, 8, key)
+    x = jax.random.normal(jax.random.fold_in(key, 99), (3, 8))
+
+    def rev_custom(subsets, x):
+        a, b = rev_sequence(fns, subsets, x, x)
+        return jnp.sum((a + b) ** 2)
+
+    def rev_direct(subsets, x):
+        a, b = x, x
+        for f, s in zip(fns, subsets):
+            a, b = b, a + f(s, b)
+        return jnp.sum((a + b) ** 2)
+
+    v1, g1 = jax.value_and_grad(rev_custom, argnums=(0, 1))(subsets, x)
+    v2, g2 = jax.value_and_grad(rev_direct, argnums=(0, 1))(subsets, x)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+    for t1, t2 in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(t1), np.asarray(t2), rtol=1e-4, atol=1e-5)
+
+
+def momentum_sequence_grad_test():
+    key = jax.random.PRNGKey(1)
+    fns, subsets = _toy_fns(4, 8, key)
+    x = jax.random.normal(jax.random.fold_in(key, 98), (3, 8))
+    alpha = 0.9
+
+    def mom_custom(subsets, x):
+        a, b = momentum_sequence(fns, alpha, subsets, x, x)
+        return jnp.sum((a + b) ** 2)
+
+    def mom_direct(subsets, x):
+        xx, v = x, x
+        for f, s in zip(fns, subsets):
+            v = v * alpha + f(s, xx) * (1 - alpha)
+            xx = xx + v
+        return jnp.sum((xx + v) ** 2)
+
+    v1, g1 = jax.value_and_grad(mom_custom, argnums=(0, 1))(subsets, x)
+    v2, g2 = jax.value_and_grad(mom_direct, argnums=(0, 1))(subsets, x)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+    for t1, t2 in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(t1), np.asarray(t2), rtol=1e-4, atol=1e-5)
+
+
+def revnet_model_grads_match_direct_test():
+    """End-to-end: revnet strategy grads == differentiating the same rev
+    recurrence without the custom vjp (strategy none can't be compared — the
+    function differs — so compare against an inline non-custom rev stack)."""
+    params = make_params(memory_reduction_strategy="revnet", depth=2)
+    m = Model(params)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, params)
+    variables = m.init(batch)
+
+    loss_custom, g_custom = jax.jit(jax.value_and_grad(
+        lambda v: m.apply(v, batch).total_loss.data))(variables)
+
+    # monkeypatch rev_sequence's custom vjp away by calling the raw python body
+    from homebrewnlp_tpu.model import blocks as blocks_mod
+    orig = blocks_mod.rev_sequence
+
+    def plain_rev(fns, subsets, x1, x2):
+        for f, s in zip(fns, subsets):
+            x1, x2 = x2, x1 + f(s, x2)
+        return x1, x2
+
+    blocks_mod.rev_sequence = plain_rev
+    try:
+        loss_plain, g_plain = jax.jit(jax.value_and_grad(
+            lambda v: m.apply(v, batch).total_loss.data))(variables)
+    finally:
+        blocks_mod.rev_sequence = orig
+
+    np.testing.assert_allclose(float(loss_custom), float(loss_plain), rtol=1e-5)
+    for k in g_custom:
+        np.testing.assert_allclose(np.asarray(g_custom[k], np.float32),
+                                   np.asarray(g_plain[k], np.float32),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def shared_grads_accumulate_test():
+    """Shared attention-map embeds receive gradient contributions from every
+    depth: grad magnitude should not vanish with depth."""
+    params = make_params(depth=3, memory_reduction_strategy="revnet")
+    m = Model(params)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, params)
+    variables = m.init(batch)
+    _, grads = jax.jit(jax.value_and_grad(
+        lambda v: m.apply(v, batch).total_loss.data))(variables)
+    shared = [k for k in grads if 'attention' in k and 'embed' in k]
+    assert shared and all(float(jnp.sum(jnp.abs(grads[k].astype(jnp.float32)))) > 0
+                          for k in shared)

@@ -1,0 +1,110 @@
+"""Init-statistics and weight-sharing tests.
+
+Port of /root/reference/tests/variable_test.py: normal/orthogonal/embedding
+init statistics (incl. the analytic expected-std formula for orthogonal init,
+variable_test.py:75-88) and `shared`-flag identity across body blocks
+(:123-142).
+"""
+import numpy as np
+import pytest
+
+from backend import make_params, tolerance, OpHarness
+from homebrewnlp_tpu.config import BlockArgs
+from homebrewnlp_tpu.core import scope
+from homebrewnlp_tpu.model import backend as model_backend
+from homebrewnlp_tpu.model.utils import get_intermediate
+from homebrewnlp_tpu.core.dims import deduplicate
+
+
+def _orthogonal_expected_std(params, shape, in_dims):
+    size = int(np.prod([d.size for d in shape]))
+    intermediate = int(np.prod([d.size for d in in_dims]))
+    min_fan = min(size // intermediate, intermediate)
+    std = ((min_fan * (1 - min_fan / size) ** 2
+            + (size - min_fan) * (min_fan / size) ** 2) / size) ** 0.5
+    return std
+
+
+@pytest.mark.parametrize("features_per_head", [16, 64])
+@pytest.mark.parametrize("heads", [1, 4])
+@pytest.mark.parametrize("case", ["ff_in", "ff_out", "group_in", "group_out"])
+@pytest.mark.parametrize("scale_by_depth", [True, False])
+def orthogonal_init_test(features_per_head, heads, case, scale_by_depth):
+    params = make_params(features_per_head=features_per_head, heads=heads,
+                         scale_by_depth=scale_by_depth)
+    args = BlockArgs(params, None, [''], is_last=True)
+    group = get_intermediate(args(['group']))
+    in_dims, out_dims = {
+        "ff_in": (params.feature_dims, params.intermediate),
+        "ff_out": (params.intermediate, params.feature_dims),
+        "group_in": (group, params.feature_dims),
+        "group_out": (params.feature_dims, group),
+    }[case]
+    shape = deduplicate(list(in_dims) + list(out_dims))
+
+    ctx = scope.Context("init", seed=0)
+    with scope.context(ctx):
+        var = model_backend.orthogonal_var(args, shape, list(in_dims))
+    out = np.asarray(var.data, np.float32)
+
+    expected = _orthogonal_expected_std(params, shape, in_dims)
+    if scale_by_depth:
+        expected /= params.depth ** 0.5
+    tol = max(tolerance(params), 0.02 * expected + 1e-4)
+    assert abs(np.std(out) - expected) < max(tol, 0.05 * expected), \
+        (np.std(out), expected)
+
+
+@pytest.mark.parametrize("stddev,mean", [(0.02, 0.0), (0.02, 1.0), (0.004, 0.0)])
+def normal_init_test(stddev, mean):
+    params = make_params(features_per_head=64, heads=4,
+                         train_batch_size=8, sequence_length=64)
+    args = BlockArgs(params, None, [''])
+    shape = [params.head_dim, params.key_dim, params.sequence_dim]
+    ctx = scope.Context("init", seed=0)
+    with scope.context(ctx):
+        var = model_backend.normal_var(args, shape, stddev, mean)
+    out = np.asarray(var.data, np.float32)
+    assert abs(np.std(out) - stddev) < stddev * 0.05
+    assert abs(np.mean(out) - mean) < stddev * 0.05
+
+
+def shared_variable_identity_test():
+    """`shared` vars in different body blocks resolve to one parameter
+    (reference variable_test.py:123-142)."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.model import Model
+    params = make_params(depth=4)
+    m = Model(params)
+    rng = np.random.default_rng(0)
+    batch = {'token_x': jnp.asarray(rng.integers(0, 32, (4, 16, 1))),
+             'token_y': jnp.asarray(rng.integers(0, 32, (4, 16, 1)))}
+    variables = m.init(batch)
+    # each depth's attention block uses the depth-0 embeds: exactly 2
+    # attention-map embeddings exist in total (2 attention calls per block)
+    attn_embeds = [k for k in variables if 'attention' in k and 'embed' in k]
+    assert len(attn_embeds) == 2, attn_embeds
+    assert all('block0_1_' in k for k in attn_embeds)
+    # non-shared vars exist once per depth
+    bottlenecks = [k for k in variables if 'bottleneck' in k and 'orthogonal_var0' in k]
+    assert len(bottlenecks) == params.depth
+
+
+def dtype_grid_init_test():
+    """Init works across the reference's storage/slice/calc dtype grid."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.model import Model
+    rng = np.random.default_rng(0)
+    batch = {'token_x': jnp.asarray(rng.integers(0, 32, (2, 16, 1))),
+             'token_y': jnp.asarray(rng.integers(0, 32, (2, 16, 1)))}
+    for storage in ("bfloat16", "float32"):
+        for slice_ in ("bfloat16", "float32"):
+            for calc in ("bfloat16", "float32"):
+                params = make_params(storage_dtype=storage, slice_dtype=slice_,
+                                     calculation_dtype=calc, depth=1,
+                                     train_batch_size=2)
+                m = Model(params)
+                variables = m.init(batch)
+                assert all(v.dtype == params.slice_dtype for v in variables.values())
+                info = m.apply(variables, batch)
+                assert np.isfinite(float(info.total_loss.data))
