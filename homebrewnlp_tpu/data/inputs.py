@@ -1,0 +1,328 @@
+"""Input pipeline: sharded TFRecord text datasets with deterministic resume.
+
+Reference: /root/reference/src/inputs.py.  Same structure, no tf.data:
+
+- ``split_files``: deterministic filename shard per dataset-holding host
+  (inputs.py:15-30) with resume skips from the run log.
+- ``simulate_data_pipeline``: replays the run log to compute exact per-file
+  element skips so restarts resume exactly where they left off even across
+  batch/ctx changes (inputs.py:33-128).  Requires the reference's filename
+  convention ``..._<tokencount>.tfrecord``.
+- windowed token stream per record: window size ctx+patch, shift ctx
+  (inputs.py:247-249); byte records vs int64 records chosen by the
+  ``'int64' in filename`` convention (inputs.py:350,553).
+- round-robin interleave over ``interleaved_datasets`` files, weighted
+  mixing across dataset configs, background prefetch (the reference
+  serialized infeed after compute, run.py:251-256 — prefetch here overlaps
+  host decode with device steps).
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import random
+import threading
+import typing
+
+import numpy as np
+
+from ..config import ModelParameter
+from . import native_recordio
+from .tfrecord import decode_example, read_records
+
+
+def split_files(filenames: typing.List[str], slice_index: int, slice_count: int,
+                seed: int, runs_log=None):
+    if not filenames:
+        raise ValueError("no input files")
+    files = sorted(filenames)
+    if seed != 0:
+        rng = random.Random(seed)
+        rng.shuffle(files)
+
+    element_skip = [0] * len(files)
+    if runs_log:
+        file_list_skip, element_skip = simulate_data_pipeline(runs_log, files)
+        files = [files[i] for i, s in enumerate(file_list_skip) if not s]
+        element_skip = [element_skip[i] for i, s in enumerate(file_list_skip) if not s]
+    return files[slice_index::slice_count], element_skip[slice_index::slice_count]
+
+
+def _tokens_in_name(path: str) -> int:
+    return int(str(path).split('_')[-1].replace('.tfrecord', ''))
+
+
+def simulate_data_pipeline(runs_log, file_list):
+    """Replay of the run log -> (full-file skip flags, per-file token skips).
+    Port of the arithmetic in reference inputs.py:33-128."""
+    counts = [_tokens_in_name(f) for f in file_list]
+    file_list_skip = [False] * len(counts)
+    element_skip = [0] * len(counts)
+    file_idx_list = list(range(len(counts)))
+
+    for run in runs_log:
+        _counts = [counts[i] for i, s in enumerate(file_list_skip) if not s]
+        _element_skip = [element_skip[i] for i, s in enumerate(file_list_skip) if not s]
+        _file_idx = [file_idx_list[i] for i, s in enumerate(file_list_skip) if not s]
+        _counts = [c - s for c, s in zip(_counts, _element_skip)]
+
+        slice_count = run['slice_count']
+        ctx = run['ctx']
+        step_stop_count = run['steps'] * run['grad_accumulation'] * (run['batch_size'] // slice_count)
+        interleave_size = run['interleave_size']
+        token_patch_size = run['token_patch_size']
+
+        for slice_index in range(slice_count):
+            _counts_slice = _counts[slice_index::slice_count]
+            _idx_slice = _file_idx[slice_index::slice_count]
+            _stop = step_stop_count
+
+            for inter_start in range(0, len(_counts_slice), interleave_size):
+                chunk = [c - ((c - token_patch_size) % ctx) - token_patch_size
+                         for c in _counts_slice[inter_start:inter_start + interleave_size]]
+                orig_chunk = chunk.copy()
+                total_windows = sum(chunk) // ctx
+                if total_windows > _stop:
+                    i = 0
+                    while sum(chunk) > 0 and _stop > 0:
+                        while chunk[i] <= 0:
+                            i = (i + 1) % len(chunk)
+                        chunk[i] -= ctx
+                        _stop -= 1
+                        i = (i + 1) % len(chunk)
+                    removed = [o - c for o, c in zip(orig_chunk, chunk)]
+                    for c_i in range(len(chunk)):
+                        file_idx = _idx_slice[inter_start + c_i]
+                        if chunk[c_i] <= 0:
+                            file_list_skip[file_idx] = True
+                        element_skip[file_idx] += removed[c_i]
+                    if _stop <= 0:
+                        break
+                else:
+                    _stop -= total_windows
+                    for c_i in range(len(chunk)):
+                        file_idx = _idx_slice[inter_start + c_i]
+                        file_list_skip[file_idx] = True
+                        element_skip[file_idx] = orig_chunk[c_i]
+
+        for slice_index in range(slice_count):
+            skip_slice = file_list_skip[slice_index::slice_count]
+            idx_slice = file_idx_list[slice_index::slice_count]
+            for inter_start in range(0, len(skip_slice), interleave_size):
+                group = skip_slice[inter_start:inter_start + interleave_size]
+                full = sum(group) == len(group)
+                for idx in idx_slice[inter_start:inter_start + interleave_size]:
+                    file_list_skip[idx] = full
+
+    return file_list_skip, element_skip
+
+
+# ---- token extraction ----------------------------------------------------
+
+def _record_tokens(payload: bytes, int_tokens: bool) -> np.ndarray:
+    fast = native_recordio.feature_tokens(payload, "text")
+    if fast is not None:
+        return fast.astype(np.int32)
+    ex = decode_example(payload)
+    value = ex.get("text", b"")
+    if isinstance(value, (bytes, bytearray)):
+        return np.frombuffer(bytes(value), dtype=np.uint8).astype(np.int32)
+    return np.asarray(value, dtype=np.int32)
+
+
+def _file_windows(path: str, ctx: int, patch: int, skip_tokens: int,
+                  int_tokens: bool) -> typing.Iterator[np.ndarray]:
+    """Windows (size ctx+patch, shift ctx) per record; a leading token skip is
+    consumed from the file's first records (deterministic-resume support)."""
+    remaining_skip = skip_tokens
+    for payload in read_records(path):
+        tokens = _record_tokens(payload, int_tokens)
+        if remaining_skip:
+            if remaining_skip >= len(tokens):
+                remaining_skip -= len(tokens)
+                continue
+            tokens = tokens[remaining_skip:]
+            remaining_skip = 0
+        n = len(tokens)
+        window = ctx + patch
+        if n < window:
+            continue
+        starts = range(0, n - window + 1, ctx)
+        for s in starts:
+            yield tokens[s:s + window]
+
+
+class _InterleavedStream:
+    """Round-robin over up to ``cycle`` concurrently-open files
+    (tf.data interleave(cycle_length=N, block_length=1) semantics)."""
+
+    def __init__(self, files, skips, ctx, patch, cycle, int_tokens, repeat):
+        self.files = list(files)
+        self.skips = list(skips) if skips else [0] * len(self.files)
+        self.ctx = ctx
+        self.patch = patch
+        self.cycle = max(1, min(cycle, len(self.files)))
+        self.int_tokens = int_tokens
+        self.repeat = repeat
+
+    def __iter__(self):
+        next_file = 0
+        n_files = len(self.files)
+        active: typing.List[typing.Iterator[np.ndarray]] = []
+
+        def open_next(idx):
+            return _file_windows(self.files[idx % n_files], self.ctx, self.patch,
+                                 self.skips[idx % n_files] if idx < n_files else 0,
+                                 self.int_tokens)
+
+        while next_file < self.cycle:
+            active.append(open_next(next_file))
+            next_file += 1
+        i = 0
+        while active:
+            try:
+                yield next(active[i])
+                i = (i + 1) % len(active)
+            except StopIteration:
+                if next_file < n_files or self.repeat:
+                    active[i] = open_next(next_file)
+                    next_file += 1
+                else:
+                    del active[i]
+                    if active:
+                        i %= len(active)
+
+
+def _expand_glob(path: str) -> typing.List[str]:
+    import glob as globlib
+    if any(c in path for c in "*?["):
+        return sorted(globlib.glob(path))
+    if os.path.isdir(path):
+        return sorted(os.path.join(path, f) for f in os.listdir(path))
+    return [path]
+
+
+class TextDataset:
+    """gpt_neo_input equivalent (reference inputs.py:528-566): yields
+    {'token_x', 'token_y'} int32 batches of shape [batch, seq/tps, tps]."""
+
+    def __init__(self, params: ModelParameter, sub_batch_size: int,
+                 slice_index: int = 0, slice_count: int = 1, runs_log=None,
+                 repeat: bool = True):
+        self.params = params
+        self.sub_batch_size = sub_batch_size
+        streams = []
+        weights = []
+        for cfg in params.dataset_configs:
+            if cfg.get('type', 'text') != 'text':
+                continue
+            filenames = []
+            for pattern in ([cfg['path']] if isinstance(cfg['path'], str) else cfg['path']):
+                filenames.extend(_expand_glob(pattern))
+            files, skips = split_files(
+                filenames, slice_index, slice_count,
+                params.data_seed * int(params.shuffle_input_filenames), runs_log)
+            int_tokens = bool(files) and 'int64' in files[0]
+            patch = params.token_patch_size * params.output_offset
+            streams.append(_InterleavedStream(files, skips, params.sequence_length,
+                                              patch, params.interleaved_datasets,
+                                              int_tokens, repeat))
+            weights.append(float(cfg.get('weight', 1)))
+        if not streams:
+            raise ValueError("no text dataset configs")
+        self.streams = streams
+        total = sum(weights)
+        self.weights = [w / total for w in weights]
+        self.rng = np.random.default_rng(params.data_seed)
+
+    def __iter__(self):
+        p = self.params
+        its = [iter(s) for s in self.streams]
+        seq_patches = p.sequence_length // p.token_patch_size
+        tps = p.token_patch_size
+        off = p.output_offset
+        while True:
+            windows = []
+            while len(windows) < self.sub_batch_size:
+                idx = 0 if len(its) == 1 else \
+                    int(self.rng.choice(len(its), p=self.weights))
+                try:
+                    windows.append(next(its[idx]))
+                except StopIteration:
+                    if len(its) == 1:
+                        return
+                    del its[idx]
+                    w = self.weights[:idx] + self.weights[idx + 1:]
+                    total = sum(w)
+                    self.weights = [x / total for x in w]
+                    if not its:
+                        return
+            block = np.stack(windows).astype(np.int32)
+            block = block.reshape(self.sub_batch_size, seq_patches + off, tps)
+            x = block[:, :seq_patches]
+            y = block[:, off:seq_patches + off] if off > 0 else block[:, :seq_patches]
+            yield {"token_x": x, "token_y": y}
+
+
+class Prefetcher:
+    """Background-thread prefetch: overlap host decode with device compute
+    (the reference serialized infeed after the step, run.py:251-256)."""
+
+    def __init__(self, iterable, depth: int = 2):
+        self.q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._done = object()
+        self.thread = threading.Thread(target=self._fill, args=(iterable,),
+                                       daemon=True)
+        self.thread.start()
+
+    def _fill(self, iterable):
+        try:
+            for item in iterable:
+                self.q.put(item)
+        finally:
+            self.q.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self._done:
+            raise StopIteration
+        return item
+
+
+# ---- run log (DataLog) ---------------------------------------------------
+
+def runs_log_path(params: ModelParameter) -> str:
+    return os.path.join(params.model_path, "DataLog.log")
+
+
+def read_runs_log(params: ModelParameter) -> typing.List[dict]:
+    path = runs_log_path(params)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def append_runs_log(params: ModelParameter, steps: int, slice_count: int):
+    """Record this run's data-consumption parameters
+    (reference dataloader_placement.py:101-119)."""
+    os.makedirs(params.model_path, exist_ok=True)
+    entry = {"steps": int(steps),
+             "ctx": int(params.sequence_length),
+             "slice_count": int(slice_count),
+             "interleave_size": int(params.interleaved_datasets),
+             "batch_size": int(params.train_batch_size),
+             "grad_accumulation": int(params.grad_accumulation),
+             "token_patch_size": int(params.token_patch_size)}
+    with open(runs_log_path(params), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry

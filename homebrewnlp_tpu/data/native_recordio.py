@@ -1,0 +1,110 @@
+"""ctypes bindings for native/recordio.cpp (built on demand with g++).
+
+pybind11 isn't available in this image, so the native fast paths are plain C
+symbols loaded via ctypes; everything degrades to the pure-python
+implementation in tfrecord.py when the toolchain or .so is missing.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import typing
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_ROOT, "native", "recordio.cpp")
+_SO = os.path.join(_ROOT, "native", "librecordio.so")
+_lock = threading.Lock()
+_lib: typing.Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                        _SRC, "-o", _SO], check=True, capture_output=True,
+                       timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> typing.Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.rio_scan.restype = ctypes.c_long
+        lib.rio_scan.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                 ctypes.c_void_p, ctypes.c_long]
+        lib.rio_read_file.restype = ctypes.c_long
+        lib.rio_read_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long]
+        lib.rio_decode_varints.restype = ctypes.c_long
+        lib.rio_decode_varints.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                           ctypes.c_void_p, ctypes.c_long]
+        lib.rio_find_feature.restype = ctypes.c_long
+        lib.rio_find_feature.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                         ctypes.c_char_p, ctypes.c_void_p,
+                                         ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_records(path: str) -> typing.Iterator[bytes]:
+    lib = _load()
+    assert lib is not None
+    size = os.path.getsize(path)
+    buf = np.empty(size, dtype=np.uint8)
+    got = lib.rio_read_file(path.encode(), buf.ctypes.data, size)
+    if got < 0:
+        raise IOError(f"cannot read {path}")
+    max_n = max(16, size // 16)
+    offsets = np.empty(max_n, dtype=np.int64)
+    lengths = np.empty(max_n, dtype=np.int64)
+    n = lib.rio_scan(path.encode(), offsets.ctypes.data, lengths.ctypes.data, max_n)
+    if n < 0:
+        raise IOError(f"cannot scan {path} ({n})")
+    data = buf.tobytes()
+    for i in range(n):
+        o, l = int(offsets[i]), int(lengths[i])
+        yield data[o:o + l]
+
+
+def feature_tokens(payload: bytes, name: str = "text"
+                   ) -> typing.Optional[np.ndarray]:
+    """Fast path: extract a bytes or int64 'text' feature as a token array
+    (uint8 codepoints for bytes, int64 for token ids)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(payload, dtype=np.uint8)
+    offset = ctypes.c_long()
+    kind = ctypes.c_int()
+    ln = lib.rio_find_feature(buf.ctypes.data, len(payload), name.encode(),
+                              ctypes.byref(offset), ctypes.byref(kind))
+    if ln < 0:
+        return None
+    start = offset.value
+    if kind.value == 1:  # bytes
+        return buf[start:start + ln].copy()
+    if kind.value == 3:  # packed int64 varints
+        out = np.empty(ln, dtype=np.int64)
+        n = lib.rio_decode_varints(buf.ctypes.data + start, ln,
+                                   out.ctypes.data, ln)
+        return out[:n].copy()
+    return None

@@ -1,0 +1,210 @@
+"""TFRecord + tf.train.Example I/O without TensorFlow.
+
+The reference leans on tf.data's TFRecordDataset + parse_single_example
+(/root/reference/src/inputs.py:231-268); here the wire formats are implemented
+directly (they're tiny), keeping the on-disk format byte-compatible so
+existing datasets load unchanged:
+
+  TFRecord framing: u64 length | u32 masked-crc32c(length) | payload
+                    | u32 masked-crc32c(payload)
+  Example proto:    message Example { Features features = 1; }
+                    message Features { map<string, Feature> feature = 1; }
+                    message Feature  { oneof { BytesList 1, FloatList 2,
+                                               Int64List 3 } }
+
+A C++ fast path (native/recordio.cpp) accelerates bulk scanning; this module
+is the always-available fallback and the writer used by the data-prep CLIs.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import typing
+
+import numpy as np
+
+# ---- crc32c (Castagnoli), table-driven ----------------------------------
+_CRC_TABLE = np.zeros(256, dtype=np.uint32)
+for _i in range(256):
+    _c = np.uint32(_i)
+    for _ in range(8):
+        _c = np.uint32(0x82F63B78) ^ (_c >> np.uint32(1)) if _c & np.uint32(1) \
+            else _c >> np.uint32(1)
+    _CRC_TABLE[_i] = _c
+
+
+def crc32c(data: bytes) -> int:
+    crc = np.uint32(0xFFFFFFFF)
+    table = _CRC_TABLE
+    arr = np.frombuffer(data, dtype=np.uint8)
+    # chunked python loop; the C++ path replaces this for bulk reads
+    c = int(crc)
+    t = table.tolist()
+    for b in arr.tolist():
+        c = t[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# ---- protobuf wire helpers ----------------------------------------------
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: memoryview, pos: int) -> typing.Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def encode_example(features: typing.Dict[str, typing.Union[bytes, typing.Sequence[int],
+                                                           typing.Sequence[float]]]) -> bytes:
+    """Serialise a tf.train.Example with bytes / int64 / float features."""
+    feats = b""
+    for name, value in features.items():
+        if isinstance(value, (bytes, bytearray)):
+            feature = _len_delim(1, _len_delim(1, bytes(value)))  # BytesList.value
+        elif len(value) and isinstance(value[0], float):
+            payload = struct.pack(f"<{len(value)}f", *value)
+            feature = _len_delim(2, _varint((1 << 3) | 2) + _varint(len(payload)) + payload)
+        else:
+            ints = b"".join(_varint(int(v) & (2 ** 64 - 1)) for v in value)
+            feature = _len_delim(3, _varint((1 << 3) | 2) + _varint(len(ints)) + ints)
+        entry = _len_delim(1, name.encode()) + _len_delim(2, feature)
+        feats += _len_delim(1, entry)
+    return _len_delim(1, feats)  # Example.features
+
+
+def decode_example(data: bytes) -> typing.Dict[str, typing.Union[bytes, np.ndarray]]:
+    """Parse an Example into {name: bytes | int64 array | float32 array}."""
+    buf = memoryview(data)
+    out: typing.Dict[str, typing.Union[bytes, np.ndarray]] = {}
+
+    def parse_feature(fbuf: memoryview) -> typing.Union[bytes, np.ndarray]:
+        pos = 0
+        while pos < len(fbuf):
+            tag, pos = _read_varint(fbuf, pos)
+            field, wire = tag >> 3, tag & 7
+            assert wire == 2, "Feature lists are length-delimited"
+            ln, pos = _read_varint(fbuf, pos)
+            inner = fbuf[pos:pos + ln]
+            pos += ln
+            ipos = 0
+            if field == 1:      # BytesList
+                itag, ipos = _read_varint(inner, ipos)
+                iln, ipos = _read_varint(inner, ipos)
+                return bytes(inner[ipos:ipos + iln])
+            if field == 2:      # FloatList (packed)
+                itag, ipos = _read_varint(inner, ipos)
+                iln, ipos = _read_varint(inner, ipos)
+                return np.frombuffer(inner[ipos:ipos + iln], dtype="<f4").copy()
+            if field == 3:      # Int64List (packed varints)
+                itag, ipos = _read_varint(inner, ipos)
+                iln, ipos = _read_varint(inner, ipos)
+                vals = []
+                end = ipos + iln
+                while ipos < end:
+                    v, ipos = _read_varint(inner, ipos)
+                    if v >= 2 ** 63:
+                        v -= 2 ** 64
+                    vals.append(v)
+                return np.asarray(vals, dtype=np.int64)
+        return b""
+
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        ln, pos = _read_varint(buf, pos)
+        features_buf = buf[pos:pos + ln]
+        pos += ln
+        fpos = 0
+        while fpos < len(features_buf):
+            ftag, fpos = _read_varint(features_buf, fpos)
+            fln, fpos = _read_varint(features_buf, fpos)
+            entry = features_buf[fpos:fpos + fln]
+            fpos += fln
+            epos = 0
+            name = None
+            value: typing.Union[bytes, np.ndarray] = b""
+            while epos < len(entry):
+                etag, epos = _read_varint(entry, epos)
+                eln, epos = _read_varint(entry, epos)
+                body = entry[epos:epos + eln]
+                epos += eln
+                if (etag >> 3) == 1:
+                    name = bytes(body).decode()
+                else:
+                    value = parse_feature(body)
+            if name is not None:
+                out[name] = value
+    return out
+
+
+# ---- record-level I/O ----------------------------------------------------
+
+class RecordWriter:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "wb")
+
+    def write(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", masked_crc(payload)))
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def read_records(path: str, verify_crc: bool = False
+                 ) -> typing.Iterator[bytes]:
+    """Iterate raw record payloads (native fast path when available)."""
+    from . import native_recordio
+    if native_recordio.available() and not verify_crc:
+        yield from native_recordio.read_records(path)
+        return
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            payload = f.read(length)
+            f.read(4)  # payload crc
+            if len(payload) < length:
+                return
+            if verify_crc:
+                (expect,) = struct.unpack("<I", header[8:12])
+                assert masked_crc(header[:8]) == expect, f"corrupt header in {path}"
+            yield payload

@@ -1,0 +1,126 @@
+// Native TFRecord scanner + Example int64/bytes feature fast paths.
+//
+// The reference's data prep used gcc-compiled Cython for its CPU-bound hot
+// loops (/root/reference/scripts/local_text2tfrecord.pyx,
+// train_tokenizer.pyx); this plays the same role for the training-time input
+// pipeline: record-frame scanning and packed-varint decoding are the per-byte
+// loops Python is worst at.  Exposed via ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC recordio.cpp -o librecordio.so
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+// Scan TFRecord framing; fill payload offsets/lengths. Returns record count,
+// -1 on open failure, -2 if out arrays are too small.
+long rio_scan(const char* path, int64_t* offsets, int64_t* lengths, long max_n) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    long n = 0;
+    int64_t pos = 0;
+    unsigned char header[12];
+    while (true) {
+        size_t got = fread(header, 1, 12, f);
+        if (got < 12) break;
+        uint64_t len;
+        memcpy(&len, header, 8);
+        if (n >= max_n) { fclose(f); return -2; }
+        offsets[n] = pos + 12;
+        lengths[n] = (int64_t)len;
+        n++;
+        pos += 12 + (int64_t)len + 4;
+        if (fseek(f, (long)(len + 4), SEEK_CUR) != 0) break;
+    }
+    fclose(f);
+    return n;
+}
+
+// Read the whole file into caller-provided buffer. Returns bytes read or -1.
+long rio_read_file(const char* path, unsigned char* buf, long cap) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    long total = 0;
+    while (total < cap) {
+        size_t got = fread(buf + total, 1, (size_t)(cap - total), f);
+        if (got == 0) break;
+        total += (long)got;
+    }
+    fclose(f);
+    return total;
+}
+
+static inline uint64_t read_varint(const unsigned char* buf, long* pos) {
+    uint64_t result = 0;
+    int shift = 0;
+    while (true) {
+        unsigned char b = buf[*pos];
+        (*pos)++;
+        result |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) return result;
+        shift += 7;
+    }
+}
+
+// Decode a packed-varint int64 run into out. Returns count (<= max_out).
+long rio_decode_varints(const unsigned char* buf, long len, int64_t* out,
+                        long max_out) {
+    long pos = 0, n = 0;
+    while (pos < len && n < max_out) {
+        uint64_t v = read_varint(buf, &pos);
+        out[n++] = (int64_t)v;
+    }
+    return n;
+}
+
+// Locate the value payload of a named feature inside a serialized Example.
+// kind_out: 1=bytes, 2=float, 3=int64. Returns payload length and sets
+// *offset_out, or -1 if absent/malformed.
+long rio_find_feature(const unsigned char* buf, long len, const char* name,
+                      long* offset_out, int* kind_out) {
+    long pos = 0;
+    long name_len = (long)strlen(name);
+    while (pos < len) {
+        uint64_t tag = read_varint(buf, &pos);
+        if ((tag >> 3) != 1 || (tag & 7) != 2) return -1;
+        uint64_t flen = read_varint(buf, &pos);           // Features
+        long fend = pos + (long)flen;
+        while (pos < fend) {
+            uint64_t etag = read_varint(buf, &pos);       // map entry
+            uint64_t elen = read_varint(buf, &pos);
+            long eend = pos + (long)elen;
+            bool match = false;
+            (void)etag;
+            while (pos < eend) {
+                uint64_t itag = read_varint(buf, &pos);
+                uint64_t ilen = read_varint(buf, &pos);
+                if ((itag >> 3) == 1) {                   // key
+                    match = ((long)ilen == name_len &&
+                             memcmp(buf + pos, name, (size_t)name_len) == 0);
+                    pos += (long)ilen;
+                } else {                                  // Feature value
+                    if (match) {
+                        long vpos = pos;
+                        uint64_t ftag = read_varint(buf, &vpos); // oneof field
+                        uint64_t flen2 = read_varint(buf, &vpos);
+                        long lend = vpos + (long)flen2;
+                        uint64_t ltag = read_varint(buf, &vpos); // .value
+                        (void)ltag; (void)lend;
+                        uint64_t vlen = read_varint(buf, &vpos);
+                        *offset_out = vpos;
+                        *kind_out = (int)(ftag >> 3);
+                        return (long)vlen;
+                    }
+                    pos += (long)ilen;
+                }
+            }
+            pos = eend;
+        }
+        pos = fend;
+    }
+    return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Text -> TFRecord dataset builder.
+
+Equivalent of the reference's data-prep pipeline
+(/root/reference/scripts/text2tfrecord.py and the Cython
+local_text2tfrecord.pyx): chunks input text files into TFRecords holding a
+single 'text' feature (raw bytes, or int64 token ids with --tokens), named
+``<prefix>_<index>_<tokencount>.tfrecord`` so the deterministic-resume
+simulation (homebrewnlp_tpu/data/inputs.py) can replay consumption from the
+filename convention.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inputs", nargs="+", help="input text files")
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--prefix", default="part")
+    ap.add_argument("--chunk-tokens", type=int, default=2 ** 20,
+                    help="tokens per output file")
+    ap.add_argument("--records-per-file", type=int, default=1)
+    ap.add_argument("--tokens", action="store_true",
+                    help="treat input as whitespace-separated int token ids "
+                         "(writes int64 features, filenames tagged 'int64')")
+    args = ap.parse_args()
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    file_idx = 0
+    buffer: list = []
+
+    def flush():
+        nonlocal file_idx, buffer
+        if not buffer:
+            return
+        total = sum(len(b) for b in buffer)
+        tag = "int64_" if args.tokens else ""
+        name = f"{args.prefix}_{tag}{file_idx:05d}_{total}.tfrecord"
+        with RecordWriter(os.path.join(args.output_dir, name)) as w:
+            per_record = max(1, len(buffer) // args.records_per_file)
+            for i in range(0, len(buffer), per_record):
+                group = buffer[i:i + per_record]
+                if args.tokens:
+                    ids = [t for chunk in group for t in chunk]
+                    w.write(encode_example({"text": ids}))
+                else:
+                    w.write(encode_example({"text": b"".join(group)}))
+        print(f"wrote {name} ({total} tokens)")
+        file_idx += 1
+        buffer = []
+
+    pending = 0
+    for path in args.inputs:
+        if args.tokens:
+            with open(path) as f:
+                ids = [int(t) for t in f.read().split()]
+            step = args.chunk_tokens
+            for i in range(0, len(ids), step):
+                buffer.append(ids[i:i + step])
+                pending += len(buffer[-1])
+                if pending >= args.chunk_tokens:
+                    flush()
+                    pending = 0
+        else:
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(args.chunk_tokens)
+                    if not chunk:
+                        break
+                    buffer.append(chunk)
+                    pending += len(chunk)
+                    if pending >= args.chunk_tokens:
+                        flush()
+                        pending = 0
+    flush()
+
+
+if __name__ == "__main__":
+    main()
