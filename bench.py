@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Headline benchmark: LM tokens/sec/chip on the 32big_mixer recipe.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The architecture matches configs/32big_mixer.json of the reference
+(/root/reference/configs/32big_mixer.json: seq 512, 8 heads x 512
+features/head = d4096, depth 32 x 2 block parts, char vocab 256, bf16,
+revnet, adaptive_clip-sm3-momentum-learning_rate); the per-chip batch is
+sized for one chip (the reference ran batch 1024 across a 32-core pod =
+32/chip; we use 32/chip).  The reference publishes no numbers
+(BASELINE.md), so vs_baseline is tracked against the first recorded run of
+this benchmark (BENCH_BASELINE.json), giving round-over-round progress.
+"""
+import json
+import os
+import sys
+import time
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+
+BENCH_CONFIG = {
+    "model_mode": "gpt", "use_video": False, "use_language": True,
+    "sequence_length": 512, "features_per_head": 512, "heads": 8, "depth": 32,
+    "train_batch_size": 32, "vocab_size": 256,
+    "calc_accuracy": False, "memory_reduction_strategy": "revnet",
+    "block_config": [
+        {"layer": ["norm-shift-scale-features-group",
+                   "bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:shift-mid:scale-mid:features"]},
+        {"layer": ["norm-shift-scale-features-group",
+                   "attention-biased_attention_map-absolute-input_as_value-shared",
+                   "norm-shift-scale-features-group", "activation-gelu",
+                   "attention-biased_attention_map-absolute-input_as_value-shared"]}],
+    "group_linear_factor": 2,
+    "intermediate_feed_forward_multiplier_multiplier": 0.5,
+    "optimizer": "adaptive_clip:0.003-sm3-momentum:0.9:1:1-learning_rate",
+    "learning_rate": 0.01, "weight_decay": 0.0001,
+    "learning_rate_config": {"linear_warmup": {"final_step": 4096}},
+    "calculation_dtype": "bfloat16", "storage_dtype": "bfloat16",
+    "optimizer_slice_dtype": "bfloat16", "slice_dtype": "float32",
+    "scale_by_depth": True, "embedding_stddev": 0.004, "z_loss": 1e-4,
+    "use_checkpointing": False, "macro_batching": 1,
+    "model_path": "/tmp/bench_run",
+}
+
+WARMUP_STEPS = 2
+MEASURE_STEPS = 10
+
+
+def main() -> int:
+    import numpy as np
+    t_setup = time.time()
+    import jax
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.model import Model
+    from homebrewnlp_tpu.train import Trainer
+
+    cfg = dict(BENCH_CONFIG)
+    if jax.default_backend() == "cpu":
+        # CPU fallback so the benchmark always yields a number
+        cfg.update(sequence_length=64, features_per_head=64, depth=4,
+                   train_batch_size=8)
+
+    params = ModelParameter(cfg)
+    model = Model(params)
+    trainer = Trainer(params, model)
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        x = rng.integers(0, params.vocab_size,
+                         (params.train_batch_size,
+                          params.sequence_length, 1))
+        return {"token_x": jnp.asarray(x),
+                "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+
+    state = trainer.init_state(make_batch())
+    print(f"setup {time.time() - t_setup:.1f}s; compiling...", file=sys.stderr)
+
+    t_compile = time.time()
+    for _ in range(WARMUP_STEPS):
+        state, metrics = trainer.step(state, make_batch())
+    jax.block_until_ready(metrics["loss"])
+    print(f"compile+warmup {time.time() - t_compile:.1f}s", file=sys.stderr)
+
+    batches = [make_batch() for _ in range(MEASURE_STEPS)]
+    t0 = time.time()
+    for batch in batches:
+        state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.time() - t0
+
+    tokens = MEASURE_STEPS * params.train_batch_size * params.sequence_length
+    n_chips = max(1, len(jax.devices()))
+    tokens_per_sec_chip = tokens / dt / n_chips
+
+    vs_baseline = 1.0
+    record = {"value": tokens_per_sec_chip, "backend": jax.default_backend(),
+              "config": "32big_mixer/1chip", "time": time.time()}
+    if os.path.exists(BASELINE_FILE):
+        try:
+            with open(BASELINE_FILE) as f:
+                base = json.load(f)
+            if base.get("backend") == record["backend"] and base.get("value"):
+                vs_baseline = tokens_per_sec_chip / float(base["value"])
+        except Exception:
+            pass
+    else:
+        try:
+            with open(BASELINE_FILE, "w") as f:
+                json.dump(record, f)
+        except OSError:
+            pass
+
+    print(json.dumps({"metric": "LM tokens/sec/chip @ 32big_mixer",
+                      "value": round(tokens_per_sec_chip, 2),
+                      "unit": "tokens/sec/chip",
+                      "vs_baseline": round(vs_baseline, 4)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

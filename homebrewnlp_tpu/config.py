@@ -47,9 +47,12 @@ class LearningRateConfig:
 
 
 class ModelParameter:
-    def __init__(self, config: typing.Dict[str, typing.Any]):
+    def __init__(self, config: typing.Dict[str, typing.Any],
+                 **overrides: typing.Any):
         if isinstance(config, ModelParameter):
-            config = dict(config.__dict__)
+            config = dict(config._raw_config)
+        config = {**config, **overrides}
+        self._raw_config = dict(config)
 
         # ---- defaults: key-for-key with /root/reference/src/dataclass.py:38-179
         self.position_embedding = "absolute"

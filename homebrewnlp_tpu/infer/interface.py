@@ -1,0 +1,107 @@
+"""Inference interface: tokenisation, CLI query REPL, debug similarity mode.
+
+Reference: /root/reference/src/interface.py
+ — byte-level or GPT2-BPE detokenisation (:61-88), interactive query REPL
+(:177-220), and the `debug` run mode that scores output similarity across
+parallel identical queries (:283-302), which doubles as an SPMD-divergence
+check.
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from ..config import ModelParameter
+from ..model import Model
+from .sampler import sample_text
+
+
+class Tokenizer:
+    """Byte-level for vocab<=256; GPT2-BPE via transformers otherwise
+    (matching the reference's convention)."""
+
+    def __init__(self, params: ModelParameter):
+        self.params = params
+        self._bpe = None
+        if params.vocab_size > 256:
+            try:
+                from transformers import GPT2TokenizerFast
+                self._bpe = GPT2TokenizerFast.from_pretrained("gpt2")
+            except Exception:
+                self._bpe = None
+
+    def encode(self, text: str) -> np.ndarray:
+        if self._bpe is not None:
+            return np.asarray(self._bpe.encode(text), np.int32)
+        return np.frombuffer(text.encode("utf-8", "replace"), np.uint8
+                             ).astype(np.int32) % self.params.vocab_size
+
+    def decode(self, tokens: typing.Sequence[int]) -> str:
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        if self._bpe is not None:
+            return self._bpe.decode(toks)
+        return bytes(t % 256 for t in toks).decode("utf-8", "replace")
+
+
+class InterfaceWrapper:
+    """complete(prompt, temperature, response_len) over a loaded model."""
+
+    def __init__(self, params: ModelParameter, model: Model, variables):
+        self.params = params
+        self.model = model
+        self.variables = variables
+        self.tokenizer = Tokenizer(params)
+
+    def complete_tokens(self, tokens: np.ndarray, temperature: float = 0.0,
+                        response_len: typing.Optional[int] = None,
+                        seed: int = 0) -> np.ndarray:
+        seq = self.params.sequence_length // self.params.token_patch_size
+        prompt_len = min(len(tokens), seq - 1)
+        end = seq if response_len is None else min(seq, prompt_len + response_len)
+        out = sample_text(self.model, self.variables, tokens[None, :prompt_len],
+                          initial_pos=prompt_len, temperature=temperature,
+                          end_iterations=end, seed=seed)
+        return out[0, :end, 0] if out.ndim == 3 else out[0, :end]
+
+    def complete(self, query: str, temperature: float = 0.0,
+                 response_len: typing.Optional[int] = None, seed: int = 0) -> str:
+        tokens = self.tokenizer.encode(query)
+        out = self.complete_tokens(tokens, temperature, response_len, seed)
+        return self.tokenizer.decode(out[len(tokens):])
+
+
+def query_repl(interface: InterfaceWrapper):
+    """Interactive REPL (reference interface.py:177-220)."""
+    print("query mode — empty line to exit")
+    while True:
+        try:
+            prompt = input("prompt> ")
+        except EOFError:
+            return
+        if not prompt:
+            return
+        try:
+            temp = float(input("temperature (default "
+                               f"{interface.params.sampling_temperature})> ") or
+                         interface.params.sampling_temperature)
+        except ValueError:
+            temp = interface.params.sampling_temperature
+        print(interface.complete(prompt, temperature=temp))
+
+
+def debug_similarity(interface: InterfaceWrapper, n: typing.Optional[int] = None
+                     ) -> float:
+    """Spawn identical queries and score token agreement
+    (reference interface.py:283-302); with temperature 0 the outputs must be
+    identical — a runtime determinism / SPMD-divergence check."""
+    params = interface.params
+    n = n or params.equal_debugging_items_per_check
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, params.vocab_size, 8).astype(np.int32)
+    outs = [interface.complete_tokens(prompt, temperature=0.0, seed=0)
+            for _ in range(n)]
+    matches = sum(np.array_equal(outs[0], o) for o in outs[1:])
+    score = matches / max(1, len(outs) - 1)
+    print(f"debug similarity: {score:.3f} ({matches}/{len(outs) - 1} identical)")
+    return score

@@ -1,0 +1,93 @@
+"""FastAPI serving mode (reference: /root/reference/src/rest_api.py).
+
+Endpoints: /completion, /token_completion, /encode, /decode, mirroring the
+reference's RestAPI surface (:74-89).  fastapi/uvicorn are optional — when
+absent (as in this image) a dependency-free fallback HTTP server provides the
+same JSON endpoints so web_api mode always works.
+"""
+from __future__ import annotations
+
+import json
+import typing
+
+from ..config import ModelParameter
+from .interface import InterfaceWrapper
+
+DEFAULT_PORT = 62220
+
+
+def _handlers(interface: InterfaceWrapper):
+    def completion(body: dict) -> dict:
+        prompt = body.get("prompt", "")
+        temperature = float(body.get("temperature", 0.0))
+        max_tokens = body.get("max_tokens")
+        text = interface.complete(prompt, temperature,
+                                  int(max_tokens) if max_tokens else None)
+        return {"completion": text}
+
+    def token_completion(body: dict) -> dict:
+        import numpy as np
+        tokens = np.asarray(body.get("tokens", []), np.int32)
+        temperature = float(body.get("temperature", 0.0))
+        max_tokens = body.get("max_tokens")
+        out = interface.complete_tokens(tokens, temperature,
+                                        int(max_tokens) if max_tokens else None)
+        return {"tokens": [int(t) for t in out]}
+
+    def encode(body: dict) -> dict:
+        return {"tokens": [int(t) for t in interface.tokenizer.encode(body.get("prompt", ""))]}
+
+    def decode(body: dict) -> dict:
+        return {"prompt": interface.tokenizer.decode(body.get("tokens", []))}
+
+    return {"/completion": completion, "/token_completion": token_completion,
+            "/encode": encode, "/decode": decode}
+
+
+def serve(params: ModelParameter, interface: InterfaceWrapper,
+          workers: int = 1, port: int = DEFAULT_PORT):
+    handlers = _handlers(interface)
+    try:
+        import fastapi
+        import uvicorn
+        app = fastapi.FastAPI()
+        for path, fn in handlers.items():
+            def make_endpoint(f=fn):
+                async def endpoint(body: dict):
+                    return f(body)
+                return endpoint
+            app.post(path)(make_endpoint())
+        uvicorn.run(app, host="0.0.0.0", port=port, workers=workers)
+        return
+    except ImportError:
+        pass
+
+    # stdlib fallback with the same endpoints
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            fn = handlers.get(self.path)
+            if fn is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                result = fn(body)
+                payload = json.dumps(result).encode()
+                self.send_response(200)
+            except Exception as e:  # surface errors as JSON
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    print(f"serving on :{port} (stdlib fallback; install fastapi+uvicorn for ASGI)")
+    ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()

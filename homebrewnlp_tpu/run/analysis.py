@@ -1,0 +1,47 @@
+"""Parameter-count analysis (parity with the reference's analyze_model,
+/root/reference/src/run/utils_run.py:65-113): prints a breakdown into
+embedding / body / core counts plus all dimension names, and dumps
+``model_size.info`` JSON into the model dir.
+"""
+from __future__ import annotations
+
+import json
+import os
+import typing
+
+import numpy as np
+
+from ..config import ModelParameter
+
+
+def analyze_model(params: ModelParameter, variables: typing.Dict[str, np.ndarray],
+                  param_dims: typing.Dict[str, tuple],
+                  dump: bool = True) -> typing.Dict[str, typing.Any]:
+    sizes = {name: int(np.prod(v.shape)) if v.ndim else 1
+             for name, v in variables.items()}
+    total = sum(sizes.values())
+    embedding = sum(s for n, s in sizes.items() if "embed" in n)
+    body = sum(s for n, s in sizes.items() if "/body" in n)
+    core = total - embedding
+    dims = sorted({d.name for dims in param_dims.values() for d in dims})
+
+    report = {
+        "total_parameters": total,
+        "core_parameters": core,
+        "embedding_parameters": embedding,
+        "body_parameters": body,
+        "variable_count": len(sizes),
+        "dimensions": dims,
+        "largest": sorted(sizes.items(), key=lambda kv: -kv[1])[:10],
+    }
+    print(f"total parameters:     {total:,}")
+    print(f"  core (non-embed):   {core:,}")
+    print(f"  embedding:          {embedding:,}")
+    print(f"  body:               {body:,}")
+    print(f"  variables:          {len(sizes)}")
+    print(f"  dimensions:         {', '.join(dims)}")
+    if dump:
+        os.makedirs(params.model_path, exist_ok=True)
+        with open(os.path.join(params.model_path, "model_size.info"), "w") as f:
+            json.dump(report, f, indent=2)
+    return report

@@ -1,0 +1,80 @@
+"""Run-mode registry: train / sample / query / web_api / debug.
+
+Reference: RUN_MODE_FNS in /root/reference/src/main.py:36-41.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import numpy as np
+
+from ..config import ModelParameter
+from ..core import sharding as shardlib
+from ..infer.interface import InterfaceWrapper, Tokenizer, debug_similarity, query_repl
+from ..model import Model
+from ..train import checkpoint as ckpt
+from .train_loop import train as train_loop
+
+
+def _load_model(params: ModelParameter):
+    params = ModelParameter(params, train=False, train_batch_size=1)
+    model = Model(params)
+    seq = params.sequence_length // params.token_patch_size
+    batch = {"token_x": np.zeros((1, seq, params.token_patch_size), np.int32),
+             "token_y": np.zeros((1, seq, params.token_patch_size), np.int32)}
+    variables = model.init(batch)
+    restored = ckpt.restore(params.model_path)
+    if restored:
+        loaded, _, step, _ = restored
+        variables = {k: np.asarray(loaded[k]).astype(variables[k].dtype)
+                     if k in loaded else v for k, v in variables.items()}
+        print(f"loaded checkpoint at step {step}")
+    else:
+        print("no checkpoint found — sampling from random init")
+    return params, model, {k: jax.numpy.asarray(v) for k, v in variables.items()}
+
+
+def train_mode(params: ModelParameter, args):
+    result = train_loop(params)
+    print(result)
+
+
+def sample_mode(params: ModelParameter, args):
+    params, model, variables = _load_model(params)
+    interface = InterfaceWrapper(params, model, variables)
+    tok = Tokenizer(params)
+    rng = np.random.default_rng(0)
+    for i in range(params.num_of_sample):
+        prompt = rng.integers(0, params.vocab_size, 8).astype(np.int32)
+        out = interface.complete_tokens(prompt,
+                                        temperature=params.sampling_temperature,
+                                        seed=i)
+        print(f"--- sample {i} ---")
+        print(tok.decode(out))
+
+
+def query_mode(params: ModelParameter, args):
+    params, model, variables = _load_model(params)
+    query_repl(InterfaceWrapper(params, model, variables))
+
+
+def web_api_mode(params: ModelParameter, args):
+    params, model, variables = _load_model(params)
+    interface = InterfaceWrapper(params, model, variables)
+    from ..infer.rest_api import serve
+    serve(params, interface, workers=getattr(args, "workers", 1))
+
+
+def debug_mode(params: ModelParameter, args):
+    params, model, variables = _load_model(params)
+    debug_similarity(InterfaceWrapper(params, model, variables))
+
+
+RUN_MODE_FNS: typing.Dict[str, typing.Callable] = {
+    "train": train_mode,
+    "sample": sample_mode,
+    "query": query_mode,
+    "web_api": web_api_mode,
+    "debug": debug_mode,
+}

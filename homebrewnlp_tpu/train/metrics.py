@@ -1,0 +1,106 @@
+"""Metrics: TensorBoard event files + console logging, TF-free.
+
+The reference emits scalars through TF1 summary_ops_v2 via
+tpu.outside_compilation host callbacks (/root/reference/src/run/utils_run.py:32-58,
+src/main.py:150-151).  Here metrics come back from the jitted step as plain
+arrays and are written as TensorBoard event files directly — an events file
+is just a TFRecord stream of Event protos, so the wire encoder from
+data/tfrecord.py covers it.  Console logging mirrors utils_core.color_print.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import time
+import typing
+
+from ..data.tfrecord import RecordWriter, _len_delim, _varint
+
+
+def _float_field(field: int, value: float) -> bytes:
+    return _varint((field << 3) | 1) + struct.pack("<d", value)
+
+
+def _float32_field(field: int, value: float) -> bytes:
+    return _varint((field << 3) | 5) + struct.pack("<f", value)
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _varint((field << 3) | 0) + _varint(value & (2 ** 64 - 1))
+
+
+def encode_scalar_event(step: int, tag: str, value: float,
+                        wall_time: typing.Optional[float] = None) -> bytes:
+    summary_value = (_len_delim(1, tag.encode())      # Summary.Value.tag
+                     + _float32_field(2, float(value)))  # simple_value
+    summary = _len_delim(1, summary_value)
+    event = (_float_field(1, wall_time if wall_time is not None else time.time())
+             + _int_field(2, int(step))
+             + _len_delim(5, summary))                # Event.summary
+    return event
+
+
+def encode_file_version_event() -> bytes:
+    return (_float_field(1, time.time())
+            + _len_delim(3, b"brain.Event:2"))
+
+
+class SummaryWriter:
+    """TensorBoard-compatible scalar writer."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self._writer = RecordWriter(os.path.join(logdir, fname))
+        self._writer.write(encode_file_version_event())
+
+    def scalar(self, tag: str, value: float, step: int):
+        self._writer.write(encode_scalar_event(step, tag, value))
+
+    def flush(self):
+        self._writer._f.flush()
+
+    def close(self):
+        self._writer.close()
+
+
+class MetricLogger:
+    """Console + JSONL + TensorBoard in one call."""
+
+    def __init__(self, model_path: str, enable_tb: bool = True):
+        self.model_path = model_path
+        os.makedirs(model_path, exist_ok=True)
+        self.jsonl = open(os.path.join(model_path, "metrics.jsonl"), "a")
+        self.tb = SummaryWriter(model_path) if enable_tb else None
+        self._t0 = time.time()
+        self._last_step_time = self._t0
+        self._last_step = None
+
+    def log(self, step: int, metrics: typing.Dict[str, typing.Any],
+            tokens_per_step: typing.Optional[int] = None):
+        now = time.time()
+        vals = {k: float(v) for k, v in metrics.items()}
+        if self._last_step is not None and step > self._last_step:
+            dt = now - self._last_step_time
+            vals["steps_per_sec"] = (step - self._last_step) / max(dt, 1e-9)
+            if tokens_per_step:
+                vals["tokens_per_sec"] = vals["steps_per_sec"] * tokens_per_step
+        self._last_step = step
+        self._last_step_time = now
+        entry = {"step": int(step), "wall": now - self._t0, **vals}
+        self.jsonl.write(json.dumps(entry) + "\n")
+        self.jsonl.flush()
+        if self.tb is not None:
+            for k, v in vals.items():
+                self.tb.scalar(k, v, step)
+            self.tb.flush()
+        stamp = time.strftime("%H:%M:%S")
+        parts = " ".join(f"{k}={v:.5g}" for k, v in vals.items())
+        print(f"\x1b[32;1m[{stamp}]\x1b[0m step={step} {parts}", flush=True)
+
+    def close(self):
+        self.jsonl.close()
+        if self.tb is not None:
+            self.tb.close()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""CLI entry point.
+
+Mirrors the reference CLI (/root/reference/main.py:17-22):
+  python3 main.py --model configs/foo.json --run_mode {train,sample,query,web_api,debug}
+``--tpu``/``--workers``/``--debug_grad`` are accepted for drop-in
+compatibility (TPU connection is implicit through jax; no TF1 session).
+"""
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", type=str, default="config.json",
+                    help="path to the model config JSON")
+    ap.add_argument("--tpu", type=str, default="",
+                    help="accepted for compatibility; jax discovers devices")
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--run_mode", type=str, default="train",
+                    choices=["train", "sample", "query", "web_api", "debug"])
+    ap.add_argument("--debug_grad", action="store_true")
+    args = ap.parse_args()
+
+    with open(args.model) as f:
+        config = json.load(f)
+
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.run.modes import RUN_MODE_FNS
+    from homebrewnlp_tpu.train import checkpoint as ckpt
+
+    params = ModelParameter(config)
+    params.debug_gradients = args.debug_grad
+    params.train = args.run_mode == "train"
+    if not params.use_autoregressive_sampling and args.run_mode in ("sample",):
+        print("use_autoregressive_sampling is off; enabling for sample mode")
+        params.use_autoregressive_sampling = True
+    params.current_step = ckpt.latest_step(params.model_path)
+
+    RUN_MODE_FNS[args.run_mode](params, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

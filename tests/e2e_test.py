@@ -1,0 +1,118 @@
+"""End-to-end CLI tests: dataset -> main.py train -> checkpoint -> restore ->
+sample/query machinery, exercising the whole L0..L8 stack on CPU (the
+reference's 32ctx smoke-test recipe in miniature, BASELINE.md 'Smoke')."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from backend import MIXER_BLOCKS
+from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_dataset(tmp_path, n_files=3, tokens_per_file=4096):
+    data_dir = tmp_path / "data"
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n_files):
+        # learnable byte stream: repeating alphabet with noise
+        base = np.tile(np.arange(32, dtype=np.uint8), tokens_per_file // 32 + 1)
+        noise = rng.integers(0, 32, tokens_per_file).astype(np.uint8)
+        tokens = np.where(rng.random(tokens_per_file) < 0.05, noise,
+                          base[:tokens_per_file])
+        with RecordWriter(str(data_dir / f"p_{i}_{tokens_per_file}.tfrecord")) as w:
+            w.write(encode_example({"text": tokens.tobytes()}))
+    return data_dir
+
+
+def _config(tmp_path, data_dir, **overrides):
+    cfg = {
+        "model_mode": "gpt", "use_video": False, "use_language": True,
+        "sequence_length": 32, "features_per_head": 16, "heads": 2,
+        "depth": 2, "train_batch_size": 8, "vocab_size": 32,
+        "calc_accuracy": True, "memory_reduction_strategy": "revnet",
+        "block_config": MIXER_BLOCKS,
+        "group_linear_factor": 2,
+        "intermediate_feed_forward_multiplier_multiplier": 0.5,
+        "optimizer": "adaptive_clip:0.003-sm3-momentum:0.9:1:1-learning_rate",
+        "learning_rate": 0.01, "weight_decay": 0.0001,
+        "learning_rate_config": {"linear_warmup": {"final_step": 16}},
+        "macro_batching": 1, "train_steps": 30, "interleaved_datasets": 2,
+        "use_checkpointing": True, "steps_per_checkpoint": 50,
+        "max_checkpoints_keep": 2, "data_seed": 1337,
+        "sampling_temperature": 0.0, "use_autoregressive_sampling": True,
+        "initial_autoregressive_position": 4,
+        "dataset_configs": [{"path": str(data_dir / "*"), "type": "text",
+                             "weight": 1}],
+        "model_path": str(tmp_path / "run"),
+    }
+    cfg.update(overrides)
+    path = tmp_path / "config.json"
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return path
+
+
+def _run_cli(config_path, run_mode, timeout=420, input_text=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "main.py"), "--model",
+         str(config_path), "--run_mode", run_mode],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+        input=input_text)
+
+
+def train_and_resume_test(tmp_path):
+    data_dir = _make_dataset(tmp_path)
+    config_path = _config(tmp_path, data_dir)
+    r = _run_cli(config_path, "train")
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "total parameters" in r.stdout
+    run_dir = tmp_path / "run"
+    ckpts = [d for d in os.listdir(run_dir) if d.startswith("ckpt_")]
+    assert ckpts, os.listdir(run_dir)
+    assert os.path.exists(run_dir / "DataLog.log")
+    assert os.path.exists(run_dir / "model_size.info")
+    assert any(f.startswith("events.out.tfevents") for f in os.listdir(run_dir))
+    metrics = [json.loads(l) for l in open(run_dir / "metrics.jsonl")]
+    assert metrics[-1]["loss"] < metrics[0]["loss"]
+
+    # resume: step picks up from the checkpoint, data log has the run
+    with open(config_path) as f:
+        cfg = json.load(f)
+    cfg["train_steps"] = 40
+    with open(config_path, "w") as f:
+        json.dump(cfg, f)
+    r2 = _run_cli(config_path, "train")
+    assert r2.returncode == 0, r2.stderr[-3000:]
+    assert "restored checkpoint" in r2.stdout
+    log_lines = open(run_dir / "DataLog.log").read().strip().splitlines()
+    assert len(log_lines) == 2
+
+
+def sample_mode_test(tmp_path):
+    data_dir = _make_dataset(tmp_path, n_files=2, tokens_per_file=2048)
+    config_path = _config(tmp_path, data_dir, train_steps=10, num_of_sample=2,
+                          use_checkpointing=True)
+    r = _run_cli(config_path, "train")
+    assert r.returncode == 0, r.stderr[-3000:]
+    r = _run_cli(config_path, "sample")
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "loaded checkpoint" in r.stdout
+    assert "--- sample 0 ---" in r.stdout
+
+
+def debug_mode_similarity_test(tmp_path):
+    data_dir = _make_dataset(tmp_path, n_files=2, tokens_per_file=2048)
+    config_path = _config(tmp_path, data_dir, train_steps=5,
+                          equal_debugging_items_per_check=3)
+    r = _run_cli(config_path, "train")
+    assert r.returncode == 0, r.stderr[-3000:]
+    r = _run_cli(config_path, "debug")
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "debug similarity: 1.000" in r.stdout
