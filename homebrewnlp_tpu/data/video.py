@@ -1,0 +1,250 @@
+"""Video (jannet-mode) input pipeline.
+
+Reference: /root/reference/src/inputs.py:131-525.  Per-record features are
+``frame`` (an encoded JPEG/PNG), ``concat``, ``skip_frame`` and — when
+language tokens are enabled — ``tokens`` + ``mask``
+(the proto layout written by scripts/video2tfrecord.py:151-165 of the
+reference).  Decoding reproduces the reference's patchify arithmetic exactly
+(reshape (hp, ps, wp, ps, c) -> transpose (ps, ps, hp, wp, c) -> reshape
+(hp, wp, ps*ps*c), inputs.py:188-193), plus optional color quantisation and
+bit-folding (packing several low-bit color values into one int, :183-197).
+
+``VideoDataset`` yields the full eight-field batch dict; ``MixedTextDataset``
+is the jannet-mode text stream (zero frames + padding masks,
+inputs.py:271-371); ``mixed_dataset`` samples between configured datasets by
+weight (inputs.py:486-525).
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from ..config import ModelParameter
+from . import native_recordio
+from .inputs import Prefetcher, split_files, _expand_glob, _InterleavedStream
+from .tfrecord import decode_example, read_records
+
+
+def decode_frame_record(params: ModelParameter, payload: bytes,
+                        use_language: bool):
+    """-> (frame [hp, wp, ccs] or [hp*wp, ccs], concat, skip_frame,
+    tokens, mask) with the reference's exact patchify/quantise/fold path."""
+    ex = decode_example(payload)
+    concat = int(np.asarray(ex.get("concat", 0)).reshape(-1)[0]) \
+        if "concat" in ex else 0
+    skip_frame = int(np.asarray(ex.get("skip_frame", 0)).reshape(-1)[0]) \
+        if "skip_frame" in ex else 0
+
+    hp, wp = params.frame_height_patch, params.frame_width_patch
+    ps, c = params.patch_size, params.color_channels
+    fold = params.use_bit_fold_input_pipeline
+    ccs = params.channel_color_size
+    frame_shape = ([hp, wp, ccs] if params.three_axes else [hp * wp, ccs])
+
+    if skip_frame > 0 or concat > 0:
+        frame = np.zeros(frame_shape, np.uint32 if fold else np.uint8)
+    else:
+        import cv2
+        raw = np.frombuffer(ex["frame"], np.uint8)
+        img = cv2.imdecode(raw, cv2.IMREAD_COLOR)
+        if img is None:
+            img = np.zeros((params.frame_height, params.frame_width, c), np.uint8)
+        if img.shape[:2] != (params.frame_height, params.frame_width):
+            img = cv2.resize(img, (params.frame_width, params.frame_height))
+        if params.color_quantization_value != 256:
+            img = np.round(img.astype(np.float32)
+                           * ((params.color_quantization_value - 1) / 255))
+            img = img.astype(np.int64 if fold else np.uint8)
+        # patchify exactly as the reference (inputs.py:188-193)
+        frame = img.reshape(hp, ps, wp, ps, c).transpose(1, 3, 0, 2, 4)
+        if fold:
+            fold_count = params.fold_count
+            frame = frame.reshape(hp, wp, fold_count, ccs) if params.three_axes \
+                else frame.reshape(hp * wp, fold_count, ccs)
+            multi = (2 ** params.bit_fold_value) ** np.arange(fold_count,
+                                                              dtype=np.int64)
+            frame = (frame.astype(np.int64)
+                     * multi[(None,) * (frame.ndim - 2) + (slice(None), None)]
+                     ).sum(-2).astype(np.uint32)
+        else:
+            frame = frame.reshape(frame_shape)
+
+    tokens = mask = None
+    if use_language and params.language_token_per_frame > 0:
+        n = params.language_token_per_frame
+        tok = np.asarray(ex.get("tokens", np.zeros(n, np.int64))).reshape(-1)[:n]
+        tokens = np.zeros(n, np.int64)
+        tokens[:len(tok)] = tok
+        m = int(np.asarray(ex.get("mask", skip_frame)).reshape(-1)[0]) \
+            if "mask" in ex else skip_frame
+        mask = (np.arange(n) <= m)
+    return frame, concat, skip_frame, tokens, mask
+
+
+class VideoDataset:
+    """dataset_video equivalent: windows of sequence_length+time_patch frames
+    per file, shift sequence_length (inputs.py:398-404)."""
+
+    def __init__(self, params: ModelParameter, sub_batch_size: int,
+                 slice_index: int = 0, slice_count: int = 1,
+                 repeat: bool = True):
+        self.params = params
+        self.sub_batch_size = sub_batch_size
+        self.repeat = repeat
+        filenames: typing.List[str] = []
+        for cfg in params.dataset_configs:
+            if cfg.get("type") == "video":
+                for pattern in ([cfg["path"]] if isinstance(cfg["path"], str)
+                                else cfg["path"]):
+                    filenames.extend(_expand_glob(pattern))
+        self.files, _ = split_files(filenames, slice_index, slice_count,
+                                    params.data_seed * int(params.shuffle_input_filenames))
+
+    def _file_windows(self, path):
+        p = self.params
+        window = p.sequence_length + p.time_patch
+        buf: typing.List[tuple] = []
+        for payload in read_records(path):
+            buf.append(decode_frame_record(p, payload, p.use_language))
+            if len(buf) == window:
+                yield buf
+                buf = buf[p.sequence_length:]
+
+    def _windows(self):
+        files = list(self.files)
+        while True:
+            for path in files:
+                yield from self._file_windows(path)
+            if not self.repeat:
+                return
+
+    def __iter__(self):
+        p = self.params
+        it = self._windows()
+        tps = p.time_patch_size
+        while True:
+            group = []
+            try:
+                for _ in range(self.sub_batch_size):
+                    group.append(next(it))
+            except StopIteration:
+                return
+            frames = np.stack([np.stack([g[0] for g in win]) for win in group])
+            concat = np.stack([[g[1] for g in win] for win in group])
+            skip = np.stack([[g[2] for g in win] for win in group])
+            concat_b = (1 - concat.reshape(self.sub_batch_size, tps + 1)).astype(bool)
+            frame_mask = (1 - skip.reshape(self.sub_batch_size, tps + 1)).astype(bool)
+            out = {"frame": frames,
+                   "cat_mask_x": concat_b[:, :tps],
+                   "cat_mask_y": concat_b[:, 1:tps + 1],
+                   "vid_msk_src": frame_mask[:, :tps],
+                   "vid_msk_tgt": frame_mask[:, 1:tps + 1]}
+            if p.use_language and p.language_token_per_frame > 0:
+                tokens = np.stack([np.stack([g[3] for g in win]) for win in group])
+                token_mask = np.stack([np.stack([g[4] for g in win]) for win in group])
+                tokens = tokens.reshape(self.sub_batch_size, tps + 1,
+                                        p.language_token_patch, p.token_patch_size
+                                        ).astype(np.int32)
+                out["token_x"] = tokens[:, :tps]
+                out["token_y"] = tokens[:, 1:tps + 1]
+                tm = token_mask[:, 1:tps + 1].reshape(
+                    self.sub_batch_size, tps, p.language_token_patch,
+                    p.token_patch_size)
+                out["txt_msk"] = tm.astype(bool)
+            yield out
+
+
+class MixedTextDataset:
+    """dataset_text equivalent for jannet mode: text windows with zero frames
+    and padding masks (inputs.py:271-371)."""
+
+    def __init__(self, params: ModelParameter, sub_batch_size: int,
+                 slice_index: int = 0, slice_count: int = 1,
+                 repeat: bool = True):
+        self.params = params
+        self.sub_batch_size = sub_batch_size
+        filenames: typing.List[str] = []
+        for cfg in params.dataset_configs:
+            if cfg.get("type", "text") == "text":
+                for pattern in ([cfg["path"]] if isinstance(cfg["path"], str)
+                                else cfg["path"]):
+                    filenames.extend(_expand_glob(pattern))
+        files, skips = split_files(filenames, slice_index, slice_count,
+                                   params.data_seed * int(params.shuffle_input_filenames))
+        int_tokens = bool(files) and "int64" in files[0]
+        ltpf = params.language_token_per_frame
+        ctx = params.time_patch_size * (ltpf - 1)
+        self.stream = _InterleavedStream(files, skips, ctx, ltpf - 1,
+                                         params.interleaved_datasets,
+                                         int_tokens, repeat)
+
+    def __iter__(self):
+        p = self.params
+        b = self.sub_batch_size
+        tps = p.time_patch_size
+        ltpf = p.language_token_per_frame
+        hp, wp, ccs = (p.frame_height_patch, p.frame_width_patch,
+                       p.channel_color_size)
+        frame_shape = (b, tps + 1, hp, wp, ccs) if p.three_axes else \
+            (b, tps + 1, hp * wp, ccs)
+        it = iter(self.stream)
+        while True:
+            windows = []
+            try:
+                for _ in range(b):
+                    windows.append(next(it))
+            except StopIteration:
+                return
+            x = np.stack(windows).astype(np.int32).reshape(b, tps + 1, ltpf - 1)
+            pad = np.full((b, tps + 1, 1), p.padding_token, np.int32)
+            x = np.concatenate([x, pad], axis=2)
+            x = x.reshape(b, tps + 1, p.language_token_patch, p.token_patch_size)
+            token_x = x[:, :tps]
+            token_y = x[:, 1:tps + 1]
+            yield {"frame": np.zeros(frame_shape, np.uint8),
+                   "token_x": token_x, "token_y": token_y,
+                   "txt_msk": token_y != p.concat_token,
+                   "vid_msk_src": np.zeros((b, tps), bool),
+                   "vid_msk_tgt": np.zeros((b, tps), bool),
+                   "cat_mask_x": np.ones((b, tps), bool),
+                   "cat_mask_y": np.ones((b, tps), bool)}
+
+
+def mixed_dataset(params: ModelParameter, sub_batch_size: int,
+                  slice_index: int = 0, slice_count: int = 1,
+                  repeat: bool = True, seed: typing.Optional[int] = None):
+    """dataset() equivalent: weighted sampling between video and text streams
+    (inputs.py:486-525); frames cast to int32 unless bit-folded."""
+    streams = []
+    weights = []
+    for cfg in params.dataset_configs:
+        dtype = cfg.get("type", "text")
+        if dtype not in ("video", "text"):
+            raise ValueError(f"{dtype} is not a supported dataset type")
+        single = ModelParameter(params, dataset_configs=[cfg])
+        if dtype == "video":
+            streams.append(iter(VideoDataset(single, sub_batch_size,
+                                             slice_index, slice_count, repeat)))
+        elif params.use_language:
+            streams.append(iter(MixedTextDataset(single, sub_batch_size,
+                                                 slice_index, slice_count, repeat)))
+        weights.append(float(cfg.get("weight", 1)))
+    total = sum(weights)
+    weights = [w / total for w in weights]
+    rng = np.random.default_rng(params.data_seed if seed is None else seed)
+
+    def cast_op(batch):
+        if not params.use_bit_fold_input_pipeline and "frame" in batch:
+            batch = dict(batch, frame=batch["frame"].astype(np.int32))
+        return batch
+
+    while streams:
+        idx = 0 if len(streams) == 1 else int(rng.choice(len(streams), p=weights))
+        try:
+            yield cast_op(next(streams[idx]))
+        except StopIteration:
+            del streams[idx]
+            w = weights[:idx] + weights[idx + 1:]
+            total = sum(w) or 1.0
+            weights = [x / total for x in w]

@@ -66,8 +66,13 @@ def _input(params: ModelParameter, vid, cat_msk_src, txt_src, vid_msk_src,
         vid = cast(vid, params.calculation_dtype) / 255
         context_dimension = vid.dims[1]
         input_features = [vid.dims[-1]]
-        tgt = slice_(vid, 1, context_dimension.size, context_dimension)
-        src = slice_(vid, 0, context_dimension.size - 1, context_dimension)
+        # the reference's utils_slice unanonymizes after slicing, which renames
+        # the '_sequence' input dim to 'sequence' (src/utils_mtf.py:336-351)
+        from .utils import unanonymize
+        tgt = unanonymize(slice_(vid, 1, context_dimension.size, context_dimension),
+                          'sequence')
+        src = unanonymize(slice_(vid, 0, context_dimension.size - 1, context_dimension),
+                          'sequence')
 
         if params.empty_frame_embedding is not None:
             embed_args = base_args(params.empty_frame_embedding)
@@ -247,14 +252,14 @@ class Model:
             if key not in batch or batch[key] is None:
                 return None
             return nt(batch[key], dims)
-        vid = get('frame', p.frame_input_shape)
-        token_x = get('token_x', p.token_dim_shape)
-        token_y = get('token_y', p.token_dim_shape)
-        cat_msk_x = get('cat_mask_x', p.frame_mask_shape)
-        cat_msk_y = get('cat_mask_y', p.frame_mask_shape)
-        vid_msk_src = get('vid_msk_src', p.frame_mask_shape)
-        vid_msk_tgt = get('vid_msk_tgt', p.frame_mask_shape)
-        txt_msk = get('txt_msk', p.token_dim_shape)
+        vid = get('frame', p.frame_input_shape) if p.use_video else None
+        token_x = get('token_x', p.token_dim_shape) if p.use_language else None
+        token_y = get('token_y', p.token_dim_shape) if p.use_language else None
+        cat_msk_x = get('cat_mask_x', p.frame_mask_shape) if p.use_video else None
+        cat_msk_y = get('cat_mask_y', p.frame_mask_shape) if p.use_video else None
+        vid_msk_src = get('vid_msk_src', p.frame_mask_shape) if p.use_video else None
+        vid_msk_tgt = get('vid_msk_tgt', p.frame_mask_shape) if p.use_video else None
+        txt_msk = get('txt_msk', p.token_dim_shape) if p.use_language else None
         return vid, cat_msk_x, cat_msk_y, token_x, token_y, vid_msk_src, vid_msk_tgt, txt_msk
 
     def init(self, batch: typing.Dict[str, jax.Array], seed: typing.Optional[int] = None
