@@ -287,9 +287,10 @@ class Model:
 
     def apply(self, variables: typing.Dict[str, jax.Array],
               batch: typing.Dict[str, jax.Array],
-              rng: typing.Optional[jax.Array] = None) -> LossInfo:
+              rng: typing.Optional[jax.Array] = None,
+              mesh: typing.Any = None) -> LossInfo:
         assert self.plan is not None, "call init() first (or assign .plan)"
-        ctx = scope.Context("apply", params=variables, rng_key=rng)
+        ctx = scope.Context("apply", params=variables, rng_key=rng, mesh=mesh)
         with scope.context(ctx):
             args = self._named_inputs(batch)
             self.params.attention_idx = 0

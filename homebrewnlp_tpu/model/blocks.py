@@ -58,9 +58,12 @@ class ReplayBlock:
 
     def __call__(self, subset: Subset, x: NamedTensor) -> NamedTensor:
         outer_rng = None
+        outer_mesh = None
         if scope.in_context():
             outer_rng = scope.current().rng_key
-        ctx = scope.Context("apply", params=subset, rng_key=None)
+            outer_mesh = scope.current().mesh
+        ctx = scope.Context("apply", params=subset, rng_key=None,
+                            mesh=outer_mesh)
         if outer_rng is not None:
             ctx.rng_key = jax.random.fold_in(outer_rng,
                                              self.depth_idx * 131 + self.cfg_idx)

@@ -23,6 +23,55 @@ from .utils import (anonymize, anonymize_dim, compare_range, get_attention_dim,
                     is_masked, linear_shapes)
 
 
+def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
+                          key: typing.Union[NamedTensor, int],
+                          base: BlockArgs) -> typing.Optional[NamedTensor]:
+    """Route dot-product attention over a sequence-sharded mesh through ring
+    attention (parallel/ring_attention.py).  Only for plain softmax attention
+    on the 'sequence' dim — map-bias flags need the dense [s, s] map.  The
+    parameter-creation order matches the dense path so init (meshless) and
+    sharded apply resolve identical names."""
+    from ..core import scope as scope_mod
+    from ..core.tensor import nt, transpose_to
+    ctx = scope_mod.current()
+    mesh = ctx.mesh
+    params = args.params
+    if (mesh is None or "sequence" not in getattr(mesh, "axis_names", ())
+            or mesh.shape["sequence"] <= 1 or dim.name != "sequence"):
+        return None
+    if any(f in args.name_extras for f in
+           ("biased_softmax", "biased_attention_map", "scale_attention_map")):
+        return None
+    if not isinstance(key, NamedTensor):
+        return None
+    if "shared_key_value" in args.name_extras:
+        val = key
+    elif "input_as_value" in args.name_extras:
+        val = args.tensor
+    else:
+        val = activated_linear_out(base)
+    import jax.numpy as jnp
+    from ..parallel.ring_attention import ring_attention
+
+    canonical = [d for d in args.tensor.dims
+                 if d not in (dim, params.head_dim, params.key_dim)] \
+        + [dim, params.head_dim, params.key_dim]
+    q = transpose_to(qry, canonical)
+    # key may lack batch dims (positional embeds): broadcast via + 0*q
+    k = transpose_to(key + 0 * qry, canonical)
+    v = transpose_to(val + 0 * qry, canonical)
+    lead = canonical[:-3]
+    bsz = 1
+    for d in lead:
+        bsz *= d.size
+    shp = (bsz, dim.size, params.head_dim.size, params.key_dim.size)
+    out = ring_attention(q.data.reshape(shp), k.data.reshape(shp),
+                         v.data.reshape(shp), mesh, causal=is_masked(args),
+                         scale=1.0)  # qry already carries the reference scale
+    out_nt = nt(out.reshape([d.size for d in canonical]), canonical)
+    return transpose_to(out_nt, args.tensor.dims)
+
+
 def _masked_map(args: BlockArgs) -> typing.Tuple[NamedTensor, typing.Union[NamedTensor, int]]:
     dim = get_attention_dim(args).dim
     tmp = anonymize_dim(dim)
@@ -62,6 +111,9 @@ def attention(args: BlockArgs) -> NamedTensor:
                 isinstance(key, NamedTensor) else embed(args, [dim] + list(params.feature_dims))
         qry = activated_linear_out(base)
         qry = qry * dim.size ** -0.5
+        ring_out = _maybe_ring_attention(args, dim, qry, key, base)
+        if ring_out is not None:
+            return ring_out
         logit_shape = shape_sub(shape, shape_sub(linear_shapes(args).old,
                                                  [params.head_dim])) + [tmp]
         logit = einsum([qry, anonymize(key, dim)], output_shape=logit_shape)

@@ -1,0 +1,5 @@
+"""Parallelism building blocks: sequence/context parallelism (ring attention)
+and mesh helpers.  The reference has NO sequence parallelism (SURVEY.md §5.7)
+— long context there leans on reversible blocks only; here the sequence dim is
+a first-class mesh axis."""
+from .ring_attention import ring_attention  # noqa: F401

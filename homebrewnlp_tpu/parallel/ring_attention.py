@@ -1,0 +1,111 @@
+"""Ring attention: causal flash attention over a sequence-sharded mesh axis.
+
+Long-context sequence parallelism the reference lacks (SURVEY.md §5.7): the
+sequence dim is sharded over the ``sequence`` mesh axis; key/value blocks
+rotate around the ring with ``lax.ppermute`` over ICI while each device
+accumulates its queries' output with an online (streaming) softmax, so the
+full [seq, seq] score matrix never materialises and per-device memory is
+O(seq/P · d + blockwise scratch).  Communication overlaps compute: XLA
+schedules the ppermute of step j+1 against the matmuls of step j.
+
+Causality across shards: after j rotation steps the local device q-shard
+``i`` holds the k/v block originally from shard ``(i - j) mod P``; blocks
+from a strictly earlier shard attend fully, the diagonal block uses the
+triangular mask, later blocks contribute nothing (their contribution is
+multiplied to -inf, keeping every device in lock-step for the collective).
+"""
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, mask, m_prev, l_prev, acc):
+    """One online-softmax accumulation step.
+    q: [b, sq, h, d], k/v: [b, sk, h, d], mask: [sq, sk] additive."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores + mask[None, None, :, :]
+    m_new = jnp.maximum(m_prev, scores.max(-1))            # [b, h, q]
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new[..., None])                  # [b, h, q, k]
+    l_new = l_prev * alpha + p.sum(-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc
+
+
+def _ring_body(axis_name: str, n_shards: int, causal: bool, scale: float,
+               q, k, v):
+    my_idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    q32 = q.astype(jnp.float32) * scale
+    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, h, sq, d), jnp.float32)
+
+    qpos = my_idx * sq + jnp.arange(sq)
+
+    def step(j, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src_shard = (my_idx - j) % n_shards
+        kpos = src_shard * sq + jnp.arange(sq)
+        if causal:
+            mask = jnp.where(qpos[:, None] >= kpos[None, :], 0., -jnp.inf)
+        else:
+            mask = jnp.zeros((sq, sq), jnp.float32)
+        m, l, acc = _block_attn(q32, k_blk, v_blk, mask, m, l, acc)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, acc
+
+    carry = (k, v, m, l, acc)
+    for j in range(n_shards):  # static unroll: n_shards is small; lets XLA
+        carry = step(j, carry)  # overlap ppermute with the next matmul
+    _, _, m, l, acc = carry
+    out = acc / jnp.maximum(l[..., None], 1e-30)           # [b, h, q, d]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis_name: str = "sequence", causal: bool = True,
+                   scale: typing.Optional[float] = None) -> jax.Array:
+    """q, k, v: [batch, seq, heads, d] (global); returns same shape.
+
+    Sharding: seq over ``axis_name``; batch over 'data' and heads over
+    'model' when those axes exist in the mesh.
+    """
+    n_shards = mesh.shape[axis_name]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P("data" if "data" in mesh.axis_names else None,
+             axis_name,
+             "model" if "model" in mesh.axis_names else None,
+             None)
+    fn = jax.shard_map(
+        functools.partial(_ring_body, axis_name, n_shards, causal, scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def dense_reference(q, k, v, causal=True, scale=None):
+    """O(s^2) reference implementation for tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    if causal:
+        s = q.shape[1]
+        mask = jnp.where(jnp.arange(s)[:, None] >= jnp.arange(s)[None, :],
+                         0., -jnp.inf)
+        scores = scores + mask[None, None]
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

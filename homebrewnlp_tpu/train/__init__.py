@@ -67,7 +67,7 @@ class Trainer:
         p = self.params
 
         def loss_of(v, idx=None):
-            info = self.model.apply(v, batch, rng)
+            info = self.model.apply(v, batch, rng, mesh=self.mesh)
             return (info.total_loss.data if idx is None
                     else info.loss_list[idx].data), info
 
