@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Hyperparameter sweep runner.
+
+Equivalent of /root/reference/scripts/run_experiments.py: takes a base config
+plus a sweep config (dict of key -> list of values), forms the cartesian
+product, writes one JSON config per combination into ``buffer_configs/``, and
+launches each run — either directly, under ``run_manager.py`` (preemption
+recovery), or in a detached ``screen`` session per accelerator like the
+reference.  TPU creation commands are pluggable strings with ``{name}``
+placeholders instead of the reference's hard-coded gcloud v1.15 calls.
+"""
+import argparse
+import hashlib
+import itertools
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base_config", required=True)
+    ap.add_argument("--run_config", default="",
+                    help="JSON of {key: [values...]} to sweep")
+    ap.add_argument("--run_name_prefix", default="runs/sweep/")
+    ap.add_argument("--number_of_repetitions", type=int, default=1)
+    ap.add_argument("--repetition_start_idx", type=int, default=0)
+    ap.add_argument("--buffer_dir", default="buffer_configs")
+    ap.add_argument("--launcher", choices=["inline", "screen", "manager", "print"],
+                    default="print")
+    ap.add_argument("--create_cmd_template", default="",
+                    help="e.g. 'gcloud compute tpus tpu-vm create {name} ...'")
+    ap.add_argument("--delete_cmd_template", default="")
+    ap.add_argument("--health_cmd_template", default="")
+    ap.add_argument("--tpu_start_id", type=int, default=0)
+    ap.add_argument("--start_up_sleep", type=int, default=0)
+    args = ap.parse_args()
+
+    with open(args.base_config) as f:
+        base_config = json.load(f)
+    sweep = {}
+    if args.run_config:
+        with open(args.run_config) as f:
+            sweep = json.load(f)
+
+    os.makedirs(args.buffer_dir, exist_ok=True)
+    keys = list(sweep.keys())
+    combos = list(itertools.product(*[range(len(sweep[k])) for k in keys])) or [()]
+    main_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "main.py")
+
+    tpu_id = args.tpu_start_id
+    for pos in combos:
+        cfg = dict(base_config)
+        for idx, key in enumerate(keys):
+            cfg[key] = sweep[key][pos[idx]]
+        for rep in range(args.repetition_start_idx, args.number_of_repetitions):
+            run_name = "-".join(f"{k}={cfg[k]}" for k in keys) + f"-run={rep}"
+            run_name = (run_name.replace(" ", "_").replace("'", "")
+                        .replace(":", "=").replace(",", "-")
+                        .replace("[", "|").replace("]", "|"))
+            cfg["model_path"] = args.run_name_prefix + run_name
+            cfg_path = os.path.join(args.buffer_dir, f"{tpu_id}_{run_name}.json")
+            with open(cfg_path, "w") as w:
+                json.dump(cfg, w, indent=2)
+
+            name = f"exp-{tpu_id}"
+            train_cmd = f"{sys.executable} {main_py} --model {cfg_path} --run_mode train"
+            if args.launcher == "inline":
+                subprocess.run(train_cmd, shell=True)
+            elif args.launcher == "manager":
+                mgr = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "run_manager.py")
+                cmd = [sys.executable, mgr, train_cmd,
+                       "--model-path", cfg["model_path"]]
+                for flag, tmpl in (("--create-cmd", args.create_cmd_template),
+                                   ("--delete-cmd", args.delete_cmd_template),
+                                   ("--health-cmd", args.health_cmd_template)):
+                    if tmpl:
+                        cmd += [flag, tmpl.format(name=name)]
+                subprocess.Popen(cmd)
+            elif args.launcher == "screen" and shutil.which("screen"):
+                session = run_name if len(run_name) <= 66 else \
+                    hashlib.sha256(run_name.encode()).hexdigest()
+                subprocess.run(["screen", "-dmS", f"tpu_id:{tpu_id}--{session}",
+                                "bash", "-c", train_cmd])
+            else:
+                print(train_cmd)
+            tpu_id += 1
+            time.sleep(args.start_up_sleep)
+
+
+if __name__ == "__main__":
+    main()

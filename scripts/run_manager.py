@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Fleet manager: launch training, watch health, recover from preemption.
+
+Equivalent of /root/reference/scripts/run_manager.py:94-146 — creates the TPU,
+launches the training subprocess, polls health every 5-10 minutes, and on an
+unhealthy (preempted) TPU kills the process group, recreates the TPU, and
+relaunches the run (training resumes from its checkpoint + deterministic data
+log).  Two health sources:
+
+- TPU health via pluggable shell commands (``--create-cmd``/``--health-cmd``/
+  ``--delete-cmd``, e.g. ``gcloud compute tpus tpu-vm ...``; the reference
+  hard-coded its TPUServiceAPI).  Empty commands skip TPU management — useful
+  when the manager only supervises the process (this container).
+- training liveness via the run's ``metrics.jsonl`` heartbeat: if no step is
+  logged for ``--stall-timeout`` seconds the run counts as stalled and is
+  restarted (the reference had no stall detection).
+"""
+import argparse
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+
+def sh(cmd: str) -> subprocess.CompletedProcess:
+    return subprocess.run(cmd, shell=True, capture_output=True, text=True,
+                          timeout=1800)
+
+
+class Manager:
+    def __init__(self, args):
+        self.args = args
+        self.log = open(os.path.join(args.model_path, "run.log"), "a") \
+            if args.model_path else sys.stderr
+        os.makedirs(args.model_path, exist_ok=True) if args.model_path else None
+
+    def out(self, msg: str):
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        self.log.write(f"[{stamp}] {msg}\n")
+        self.log.flush()
+
+    def tpu_healthy(self) -> bool:
+        if not self.args.health_cmd:
+            return True
+        r = sh(self.args.health_cmd)
+        return r.returncode == 0 and ("READY" in r.stdout or "healthy" in
+                                      r.stdout.lower() or not r.stdout.strip())
+
+    def create_tpu(self, recreate: bool = False):
+        if recreate and self.args.delete_cmd:
+            self.out(f"deleting TPU: {self.args.delete_cmd}")
+            sh(self.args.delete_cmd)
+            time.sleep(30)
+        if self.args.create_cmd:
+            self.out(f"creating TPU: {self.args.create_cmd}")
+            for attempt in range(20):
+                r = sh(self.args.create_cmd)
+                if r.returncode == 0:
+                    break
+                self.out(f"create failed (attempt {attempt}): {r.stderr[-500:]}")
+                time.sleep(60)
+        # readiness wait with recreate-on-slow (reference :94-109)
+        waited = 0
+        while not self.tpu_healthy():
+            time.sleep(15)
+            waited += 15
+            if waited > 15 * 15 and self.args.create_cmd:
+                self.out("TPU slow to become ready; recreating")
+                self.create_tpu(recreate=True)
+                return
+
+    def heartbeat_age(self) -> float:
+        path = os.path.join(self.args.model_path, "metrics.jsonl") \
+            if self.args.model_path else None
+        if not path or not os.path.exists(path):
+            return 0.0
+        return time.time() - os.path.getmtime(path)
+
+    def launch(self) -> subprocess.Popen:
+        self.out(f"launching: {self.args.run_command}")
+        return subprocess.Popen(self.args.run_command, shell=True,
+                                stdout=self.log, stderr=self.log,
+                                preexec_fn=os.setsid)
+
+    def kill(self, proc: subprocess.Popen):
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            time.sleep(10)
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def run(self):
+        self.create_tpu()
+        proc = self.launch()
+        restarts = 0
+        while True:
+            time.sleep(self.args.poll_interval
+                       + random.randint(0, self.args.poll_jitter))
+            healthy = self.tpu_healthy()
+            stalled = (self.args.stall_timeout > 0
+                       and self.heartbeat_age() > self.args.stall_timeout)
+            if proc.poll() is not None:
+                if healthy:
+                    self.out(f"training exited rc={proc.returncode}; done")
+                    break
+                # process died because the TPU went away — fall through
+            if healthy and not stalled:
+                continue
+            restarts += 1
+            if 0 < self.args.max_restarts < restarts:
+                self.out("max restarts exceeded; giving up")
+                break
+            self.out(f"unhealthy={not healthy} stalled={stalled}; "
+                     f"restarting (#{restarts})")
+            self.kill(proc)
+            time.sleep(60)
+            self.create_tpu(recreate=not healthy)
+            proc = self.launch()
+        if self.args.delete_cmd:
+            self.out("deleting TPU")
+            sh(self.args.delete_cmd)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_command", help="training command to supervise")
+    ap.add_argument("--model-path", default="", help="run dir (logs, heartbeat)")
+    ap.add_argument("--create-cmd", default="", help="shell cmd creating the TPU")
+    ap.add_argument("--health-cmd", default="", help="shell cmd checking TPU health")
+    ap.add_argument("--delete-cmd", default="", help="shell cmd deleting the TPU")
+    ap.add_argument("--poll-interval", type=int, default=300)
+    ap.add_argument("--poll-jitter", type=int, default=300)
+    ap.add_argument("--stall-timeout", type=int, default=3600)
+    ap.add_argument("--max-restarts", type=int, default=0, help="0 = unlimited")
+    Manager(ap.parse_args()).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Balanced splitting of video id/duration work lists across workers.
+
+Equivalent of /root/reference/scripts/split_video_json.py +
+chunk_video_json.py: greedy longest-first bin packing of
+``[{"id": ..., "duration": ...}, ...]`` into N near-equal-duration chunks.
+"""
+import argparse
+import json
+import os
+
+
+def balanced_split(items, n):
+    bins = [[] for _ in range(n)]
+    totals = [0.0] * n
+    for item in sorted(items, key=lambda x: -float(x.get("duration", 1))):
+        i = totals.index(min(totals))
+        bins[i].append(item)
+        totals[i] += float(item.get("duration", 1))
+    return bins, totals
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input", help="JSON list of {id, duration} entries")
+    ap.add_argument("--splits", type=int, required=True)
+    ap.add_argument("--output-dir", required=True)
+    args = ap.parse_args()
+
+    with open(args.input) as f:
+        items = json.load(f)
+    if isinstance(items, dict):
+        items = [{"id": k, "duration": v} for k, v in items.items()]
+
+    bins, totals = balanced_split(items, args.splits)
+    os.makedirs(args.output_dir, exist_ok=True)
+    base = os.path.splitext(os.path.basename(args.input))[0]
+    for i, (chunk, total) in enumerate(zip(bins, totals)):
+        path = os.path.join(args.output_dir, f"{base}_{i:03d}.json")
+        with open(path, "w") as w:
+            json.dump(chunk, w)
+        print(f"{path}: {len(chunk)} videos, {total:.0f}s")
+
+
+if __name__ == "__main__":
+    main()

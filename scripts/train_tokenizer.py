@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""BPE tokenizer trainer.
+
+Equivalent of the reference's Cython trainer
+(/root/reference/scripts/train_tokenizer.pyx): trains a 65,536-vocab BPE with
+the same construction — unk token '\\x01', byte specials chr(0..255), and the
+"isolated" Split pre-tokenizer over the digits/whitespace/punctuation regex
+(train_tokenizer.pyx:180-188) — then writes ``tokenizer.json``.  The
+reference's surrounding Cython machinery streamed The Pile from the network;
+this trains from local text/jsonl files (zero-egress image), streamed through
+a multiprocess chunk-reader pool.
+"""
+import argparse
+import json
+import multiprocessing
+import os
+import string
+import sys
+
+
+def _read_chunks(path: str, chunk_bytes: int):
+    if path.endswith(".jsonl"):
+        with open(path, errors="ignore") as f:
+            buf = []
+            size = 0
+            for line in f:
+                try:
+                    text = json.loads(line).get("text", "")
+                except json.JSONDecodeError:
+                    continue
+                buf.append(text)
+                size += len(text)
+                if size >= chunk_bytes:
+                    yield "\n".join(buf)
+                    buf, size = [], 0
+            if buf:
+                yield "\n".join(buf)
+    else:
+        with open(path, errors="ignore") as f:
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    return
+                yield chunk
+
+
+def _worker(paths, queue, chunk_bytes):
+    for path in paths:
+        for chunk in _read_chunks(path, chunk_bytes):
+            queue.put(chunk)
+    queue.put(None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inputs", nargs="+", help="text or jsonl files")
+    ap.add_argument("--vocab-size", type=int, default=65536)
+    ap.add_argument("--output", default="tokenizer.json")
+    ap.add_argument("--processes", type=int, default=4)
+    ap.add_argument("--chunk-bytes", type=int, default=1 << 20)
+    args = ap.parse_args()
+
+    from tokenizers import Regex, Tokenizer
+    from tokenizers.models import BPE
+    from tokenizers.pre_tokenizers import Split
+    from tokenizers.trainers import BpeTrainer
+
+    split_chars = string.digits + " \t\n\r\x0b\x0c"
+    for c in string.punctuation:
+        split_chars += "\\" + c
+    regex = Regex(f"[{split_chars}]|[^{split_chars}]+")
+    tokenizer = Tokenizer(BPE(unk_token="\x01"))
+    tokenizer.pre_tokenizer = Split(regex, "isolated")
+    trainer = BpeTrainer(special_tokens=[chr(i) for i in range(256)],
+                         vocab_size=args.vocab_size)
+
+    nproc = min(args.processes, len(args.inputs))
+    if nproc > 1:
+        manager = multiprocessing.Manager()
+        queue = manager.Queue(maxsize=64)
+        shards = [args.inputs[i::nproc] for i in range(nproc)]
+        procs = [multiprocessing.Process(target=_worker,
+                                         args=(shard, queue, args.chunk_bytes))
+                 for shard in shards]
+        for p in procs:
+            p.start()
+
+        def iterator():
+            done = 0
+            while done < len(procs):
+                item = queue.get()
+                if item is None:
+                    done += 1
+                    continue
+                yield item
+
+        tokenizer.train_from_iterator(iterator(), trainer)
+        for p in procs:
+            p.join()
+    else:
+        def iterator():
+            for path in args.inputs:
+                yield from _read_chunks(path, args.chunk_bytes)
+        tokenizer.train_from_iterator(iterator(), trainer)
+
+    tmp = args.output + ".tmp"
+    tokenizer.save(tmp)
+    with open(tmp, errors="ignore") as r, open(args.output, "w", errors="ignore") as w:
+        w.write(json.dumps(json.loads(r.read()), indent=4))
+    os.remove(tmp)
+    print(f"wrote {args.output} (vocab {args.vocab_size})")
+
+
+if __name__ == "__main__":
+    main()
