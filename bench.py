@@ -48,7 +48,30 @@ WARMUP_STEPS = 2
 MEASURE_STEPS = 10
 
 
+def _ensure_live_backend():
+    """The axon TPU plugin blocks interpreter-wide if its tunnel is down;
+    probe it in a subprocess and re-exec on CPU when unreachable."""
+    if os.environ.get("_BENCH_BACKEND_CHECKED"):
+        return
+    import subprocess
+    env = dict(os.environ, _BENCH_BACKEND_CHECKED="1")
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=120, capture_output=True, env=env)
+        ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        print("accelerator backend unreachable; falling back to CPU",
+              file=sys.stderr)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    os.environ["_BENCH_BACKEND_CHECKED"] = "1"
+
+
 def main() -> int:
+    _ensure_live_backend()
     import numpy as np
     t_setup = time.time()
     import jax
@@ -95,23 +118,25 @@ def main() -> int:
     n_chips = max(1, len(jax.devices()))
     tokens_per_sec_chip = tokens / dt / n_chips
 
+    # first recorded value per backend becomes the baseline; later runs
+    # report progress against it
     vs_baseline = 1.0
-    record = {"value": tokens_per_sec_chip, "backend": jax.default_backend(),
-              "config": "32big_mixer/1chip", "time": time.time()}
-    if os.path.exists(BASELINE_FILE):
-        try:
+    backend = jax.default_backend()
+    baselines = {}
+    try:
+        if os.path.exists(BASELINE_FILE):
             with open(BASELINE_FILE) as f:
-                base = json.load(f)
-            if base.get("backend") == record["backend"] and base.get("value"):
-                vs_baseline = tokens_per_sec_chip / float(base["value"])
-        except Exception:
-            pass
-    else:
-        try:
+                baselines = json.load(f)
+        if backend in baselines and baselines[backend].get("value"):
+            vs_baseline = tokens_per_sec_chip / float(baselines[backend]["value"])
+        else:
+            baselines[backend] = {"value": tokens_per_sec_chip,
+                                  "config": "32big_mixer/1chip",
+                                  "time": time.time()}
             with open(BASELINE_FILE, "w") as f:
-                json.dump(record, f)
-        except OSError:
-            pass
+                json.dump(baselines, f)
+    except (OSError, ValueError):
+        pass
 
     print(json.dumps({"metric": "LM tokens/sec/chip @ 32big_mixer",
                       "value": round(tokens_per_sec_chip, 2),
