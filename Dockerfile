@@ -1,0 +1,17 @@
+# Container for the offline data-prep pipeline (video/text -> TFRecords),
+# equivalent of the reference's video-pipeline image
+# (/root/reference/scripts/Dockerfile + install_packages.sh).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        ffmpeg g++ make && \
+    rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir numpy opencv-python-headless tokenizers
+
+WORKDIR /workspace
+COPY homebrewnlp_tpu/ homebrewnlp_tpu/
+COPY native/ native/
+COPY scripts/ scripts/
+
+ENTRYPOINT ["python3"]

@@ -74,8 +74,14 @@ class ReplayBlock:
         self.params.attention_idx = self.attention_idx
         try:
             with scope.context(ctx):
-                return block_part_fn(self.params, self.block_config, x,
-                                     f"block{self.depth_idx}_{self.cfg_idx}")
+                out = block_part_fn(self.params, self.block_config, x,
+                                    f"block{self.depth_idx}_{self.cfg_idx}")
+                if outer_mesh is not None:
+                    # pin the inter-block activation layout so GSPMD keeps
+                    # batch on 'data' / heads on 'model' through the stack
+                    from ..core.sharding import with_constraint
+                    out = with_constraint(out, self.params, outer_mesh)
+                return out
         finally:
             self.params.attention_idx = saved
 

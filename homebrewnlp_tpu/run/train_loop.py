@@ -70,7 +70,12 @@ def make_dataset(params: ModelParameter, repeat: bool = True):
 
 
 def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
-          log_every: int = 10) -> typing.Dict[str, typing.Any]:
+          log_every: int = 10,
+          profile_steps: typing.Optional[typing.Tuple[int, int]] = None
+          ) -> typing.Dict[str, typing.Any]:
+    """profile_steps=(start, stop): capture a jax.profiler trace of those
+    steps into <model_path>/profile (SURVEY.md §5.1 — the reference had no
+    op-level profiler integration)."""
     devices = jax.devices()
     mesh = shardlib.build_mesh(params) if len(devices) > 1 else None
     model = Model(params)
@@ -112,7 +117,17 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     try:
         batch = first_batch
         data_it = iter(data)
+        profiling = False
         while int(state.step) < total_steps:
+            if profile_steps is not None:
+                now = int(state.step)
+                if not profiling and now >= profile_steps[0]:
+                    jax.profiler.start_trace(os.path.join(params.model_path,
+                                                          "profile"))
+                    profiling = True
+                elif profiling and now >= profile_steps[1]:
+                    jax.profiler.stop_trace()
+                    profiling = False
             state, metrics = trainer.step(state, batch)
             steps_done += params.macro_batching
             try:
@@ -129,6 +144,8 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 ckpt.save(params.model_path, step_now, state.variables,
                           state.opt_state, params.max_checkpoints_keep)
     finally:
+        if profile_steps is not None and profiling:
+            jax.profiler.stop_trace()
         if params.use_checkpointing:
             ckpt.save(params.model_path, int(state.step), state.variables,
                       state.opt_state, params.max_checkpoints_keep)

@@ -94,7 +94,16 @@ class Trainer:
         grads, info = self._grads(variables, batch, rng)
         new_vars, new_opt, lr = self.optimizer.update(variables, grads, opt_state,
                                                       step)
+        extra = {}
+        if self.params.debug_gradients:
+            # per-variable gradient norms (the reference's --debug_grad
+            # histogram summaries, src/run/run.py:147-153)
+            extra = {f"grad_norm/{k}": jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+                     for k, g in grads.items()}
+        extra["global_grad_norm"] = jnp.sqrt(sum(
+            jnp.sum(g.astype(jnp.float32) ** 2) for g in grads.values()))
         metrics = {
+            **extra,
             "loss": info.total_loss.data.astype(jnp.float32),
             "token_loss": (info.token_loss.data.astype(jnp.float32)
                            if info.token_loss is not None else jnp.float32(0)),
