@@ -90,6 +90,32 @@ def query_repl(interface: InterfaceWrapper):
         print(interface.complete(prompt, temperature=temp))
 
 
+def debug_sample_check(interface: InterfaceWrapper, seed: int = 0) -> float:
+    """Teacher-forced vs autoregressive agreement (reference
+    interface.py:146-151 / the ``debug_sample`` flag): run one greedy
+    autoregressive completion, then teacher-force the produced sequence and
+    check each step's argmax reproduces the sampled token."""
+    import jax
+    import jax.numpy as jnp
+    params = interface.params
+    rng = np.random.default_rng(seed)
+    prompt = rng.integers(0, params.vocab_size, 8).astype(np.int32)
+    out = interface.complete_tokens(prompt, temperature=0.0, seed=seed)
+    seq = params.sequence_length // params.token_patch_size
+    token_x = np.zeros((1, seq, params.token_patch_size), np.int32)
+    token_x[0, :len(out), 0] = out[:seq]
+    info = interface.model.apply(interface.variables,
+                                 {"token_x": jnp.asarray(token_x),
+                                  "token_y": jnp.asarray(token_x)})
+    logits = np.asarray(info.token_out.data, np.float32)[0, :, 0]
+    preds = logits.argmax(-1)
+    start = min(len(prompt), seq - 1)
+    # prediction at p-1 generates the token at p
+    agree = np.mean(preds[start - 1:seq - 1] == out[start:seq])
+    print(f"debug_sample teacher-forcing agreement: {agree:.3f}")
+    return float(agree)
+
+
 def debug_similarity(interface: InterfaceWrapper, n: typing.Optional[int] = None
                      ) -> float:
     """Spawn identical queries and score token agreement

@@ -20,9 +20,48 @@ from .spatial import attention, cummean, cumsum
 
 
 def convolution(args: BlockArgs) -> NamedTensor:
-    # parity with the reference, which disables its conv layer
-    # (/root/reference/src/model/convolution.py:129)
-    raise ValueError("Convolution is currently broken")
+    """Causal conv over the current attention dim.
+
+    The reference ships this layer disabled — its hand-written mtf
+    Operation raises ``ValueError("Convolution is currently broken")``
+    (/root/reference/src/model/convolution.py:129).  Here it works: a dense
+    features→features convolution with kernel ``convolution_size`` over the
+    round-robin attention axis, causal when that axis is in
+    ``masked_attention_dimensions``, via lax.conv_general_dilated (MXU path).
+    """
+    import jax.lax
+    import jax.numpy as jnp
+    from ..core.dims import Dim, shape_size
+    from ..core.tensor import nt, transpose_to
+    from .backend import orthogonal_var
+    from .utils import get_attention_dim, is_masked
+
+    params = args.params
+    dim = get_attention_dim(args).dim
+    masked = is_masked(args)
+    kernel = min(params.convolution_size, dim.size)
+    feature_dims = list(params.feature_dims)
+    kernel_dim_in = [Dim("_conv_in", shape_size(feature_dims))]
+    canonical = [d for d in args.tensor.dims if d not in feature_dims and d != dim] \
+        + [dim] + feature_dims
+    x = transpose_to(args.tensor, canonical)
+    lead = shape_size(canonical[:-1 - len(feature_dims)])
+    features = shape_size(feature_dims)
+    data = x.data.reshape(lead, dim.size, features)
+    if masked:
+        data = jnp.pad(data, ((0, 0), (kernel - 1, 0), (0, 0)))
+        padding = "VALID"
+    else:
+        padding = "SAME"
+    w = orthogonal_var(args, [Dim("_conv_k", kernel)] + kernel_dim_in
+                       + feature_dims, kernel_dim_in)
+    wdata = w.data.reshape(kernel, features, features)
+    out = jax.lax.conv_general_dilated(
+        data, wdata, window_strides=(1,), padding=padding,
+        dimension_numbers=("NWC", "WIO", "NWC"))
+    out = nt(out.reshape([d.size for d in canonical]).astype(args.tensor.dtype),
+             canonical)
+    return transpose_to(out, args.tensor.dims)
 
 
 def _get_block_part(block_part_config: BlockConfig, params: ModelParameter,

@@ -68,7 +68,10 @@ def web_api_mode(params: ModelParameter, args):
 
 def debug_mode(params: ModelParameter, args):
     params, model, variables = _load_model(params)
-    debug_similarity(InterfaceWrapper(params, model, variables))
+    interface = InterfaceWrapper(params, model, variables)
+    debug_similarity(interface)
+    from ..infer.interface import debug_sample_check
+    debug_sample_check(interface)
 
 
 RUN_MODE_FNS: typing.Dict[str, typing.Callable] = {

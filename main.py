@@ -8,6 +8,7 @@ compatibility (TPU connection is implicit through jax; no TF1 session).
 """
 import argparse
 import json
+import os
 import sys
 
 
@@ -22,6 +23,12 @@ def main():
                     choices=["train", "sample", "query", "web_api", "debug"])
     ap.add_argument("--debug_grad", action="store_true")
     args = ap.parse_args()
+
+    # multi-host pods: jax.distributed discovers peers from the standard env
+    # (the reference resolved a TPUClusterResolver here, src/main.py:107-117)
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        import jax
+        jax.distributed.initialize()
 
     with open(args.model) as f:
         config = json.load(f)
