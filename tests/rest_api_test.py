@@ -1,0 +1,78 @@
+"""REST serving test: the stdlib-fallback server answers the reference's
+four endpoints (/completion /token_completion /encode /decode)."""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+from backend import make_params
+from homebrewnlp_tpu.infer.interface import InterfaceWrapper
+from homebrewnlp_tpu.infer.rest_api import _handlers
+from homebrewnlp_tpu.model import Model
+
+
+def _interface():
+    params = make_params(train_batch_size=1, sequence_length=16,
+                         initial_autoregressive_position=4, vocab_size=256,
+                         use_autoregressive_sampling=True)
+    params.train = False
+    m = Model(params)
+    import jax.numpy as jnp
+    batch = {"token_x": np.zeros((1, 16, 1), np.int32),
+             "token_y": np.zeros((1, 16, 1), np.int32)}
+    variables = {k: jnp.asarray(v) for k, v in m.init(batch).items()}
+    return InterfaceWrapper(params, m, variables)
+
+
+def endpoints_test():
+    handlers = _handlers(_interface())
+    out = handlers["/encode"]({"prompt": "ab"})
+    assert out["tokens"] == [97, 98]
+    out = handlers["/decode"]({"tokens": [104, 105]})
+    assert out["prompt"] == "hi"
+    out = handlers["/token_completion"]({"tokens": [1, 2, 3], "temperature": 0.0})
+    assert len(out["tokens"]) == 16
+    out = handlers["/completion"]({"prompt": "ab", "temperature": 0.0})
+    assert isinstance(out["completion"], str)
+
+
+def http_server_test():
+    """Full HTTP round-trip through the stdlib fallback server."""
+    from http.server import ThreadingHTTPServer
+    from homebrewnlp_tpu.infer import rest_api
+
+    interface = _interface()
+    handlers = rest_api._handlers(interface)
+
+    # build the same handler the serve() fallback uses, on an ephemeral port
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            fn = handlers.get(self.path)
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            payload = json.dumps(fn(body)).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/encode",
+            data=json.dumps({"prompt": "hi"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert out["tokens"] == [104, 105]
+    finally:
+        server.shutdown()
