@@ -118,23 +118,25 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         batch = first_batch
         data_it = iter(data)
         profiling = False
-        while int(state.step) < total_steps:
+        # host-side step mirror: never block on state.step (a device sync per
+        # step would serialise dispatch against compute)
+        step_now = start_step
+        while step_now < total_steps:
             if profile_steps is not None:
-                now = int(state.step)
-                if not profiling and now >= profile_steps[0]:
+                if not profiling and step_now >= profile_steps[0]:
                     jax.profiler.start_trace(os.path.join(params.model_path,
                                                           "profile"))
                     profiling = True
-                elif profiling and now >= profile_steps[1]:
+                elif profiling and step_now >= profile_steps[1]:
                     jax.profiler.stop_trace()
                     profiling = False
             state, metrics = trainer.step(state, batch)
             steps_done += params.macro_batching
+            step_now += params.macro_batching
             try:
                 batch = next(data_it)
             except StopIteration:
                 break
-            step_now = int(state.step)
             if step_now % log_every < params.macro_batching:
                 last_metrics = {k: float(v) for k, v in metrics.items()}
                 logger.log(step_now, metrics,

@@ -45,6 +45,7 @@ class Trainer:
         self.mesh = mesh
         self.optimizer: typing.Optional[Optimizer] = None
         self._step_fn = None
+        self._rng_counter = 0
 
     # -- state -------------------------------------------------------------
     def init_state(self, batch: typing.Dict[str, jax.Array],
@@ -173,8 +174,11 @@ class Trainer:
              rng: typing.Optional[jax.Array] = None):
         if self._step_fn is None:
             self._step_fn = self._build_step()
+            self._rng_counter = 0
         if rng is None:
-            rng = jax.random.PRNGKey(int(state.step) if not isinstance(state.step, jax.core.Tracer) else 0)
+            # host counter, never a device sync on state.step
+            self._rng_counter += 1
+            rng = jax.random.PRNGKey(self._rng_counter)
         if self.mesh is not None:
             batch = shardlib.shard_batch(self.params, batch, self.mesh)
         return self._step_fn(state, batch, rng)
