@@ -175,7 +175,8 @@ class ModelParameter:
         # ---- TPU-native additions (defaults keep reference configs unchanged)
         self.sequence_parallel = 1           # size of the 'sequence' mesh axis
         self.mesh_shape_override: typing.Optional[typing.Dict[str, int]] = None
-        self.scan_layers = False             # lax.scan over depth (faster compiles)
+        self.layout_override: typing.Dict[str, str] = {}  # dim name -> mesh axis
+        self.scan_layers = False             # reserved (lax.scan over depth)
         self.gradient_checkpointing_policy = "nothing_saveable"
 
         for k, v in config.items():
@@ -249,7 +250,9 @@ class ModelParameter:
                 self.mesh_shape["sequence"] = self.sequence_parallel
             if not self.mesh_shape:
                 self.mesh_shape = {"data": 1}
-        # dim-name -> mesh-axis layout rules ("batch:b,heads:h" analogue)
+        # dim-name -> mesh-axis layout rules ("batch:b,heads:h" analogue);
+        # layout_override adds/replaces rules (e.g. {"experts": "model"} for
+        # expert-parallel soft-MoE with replicated heads)
         self.layout = {}
         if "data" in self.mesh_shape:
             self.layout["batch"] = "data"
@@ -257,6 +260,7 @@ class ModelParameter:
             self.layout["heads"] = "model"
         if "sequence" in self.mesh_shape:
             self.layout["sequence"] = "sequence"
+        self.layout.update(self.layout_override)
 
         self.block_config = [BlockConfig(c, self.memory_reduction_strategy)
                              for c in self.block_config]
