@@ -176,12 +176,16 @@ class ModelParameter:
         self.sequence_parallel = 1           # size of the 'sequence' mesh axis
         self.mesh_shape_override: typing.Optional[typing.Dict[str, int]] = None
         self.layout_override: typing.Dict[str, str] = {}  # dim name -> mesh axis
+        self.pipeline_stages = 1          # GPipe stages over the 'pipe' mesh axis
+        self.pipeline_microbatches: typing.Optional[int] = None  # default = stages
         self.scan_layers = False             # reserved (lax.scan over depth)
         self.gradient_checkpointing_policy = "nothing_saveable"
 
+        self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():
             if k not in self.__dict__:
                 print(f"WARNING: Unknown ModelParameter {k}={v!r}")
+                self.unknown_config_keys.append(k)
             self.__dict__[k] = v
 
         # ---- validation / derivation (reference :189-271)
@@ -204,6 +208,16 @@ class ModelParameter:
         self.learning_rate_config = {
             key: cfg if isinstance(cfg, LearningRateConfig) else LearningRateConfig(**cfg)
             for key, cfg in self.learning_rate_config.items()}
+
+        # text-only GPT mode forces the video path off (the reference does
+        # this at session bring-up, src/main.py:88-93; doing it here makes
+        # the shipped gpt configs load standalone)
+        if self.model_mode == 'gpt':
+            self.use_language = True
+            self.use_video = False
+        elif self.model_mode != 'jannet':
+            raise ValueError(f"model_mode must be 'jannet' or 'gpt', "
+                             f"got {self.model_mode!r}")
 
         self.multi_loss_strategy = self.multi_loss_strategy.lower()
         if self.multi_loss_strategy not in ("linear", "pcgrad", "mgda"):
@@ -235,12 +249,13 @@ class ModelParameter:
             self.data_seed = int(np.random.default_rng().integers(0, 1_000_000))
 
         # ---- mesh derivation: reference's 2-D batch x heads mesh (:247-252),
-        # extended with an optional sequence axis for long-context sharding.
+        # extended with optional sequence (long-context) and pipe (pipeline
+        # stages — new capability, reference has none) axes.
         if self.mesh_shape_override:
             self.mesh_shape = dict(self.mesh_shape_override)
         else:
-            data_par = max(1, self.tpu_size // (self.heads * self.sequence_parallel)) \
-                if self.heads * self.sequence_parallel < self.tpu_size else 1
+            denom = self.heads * self.sequence_parallel * self.pipeline_stages
+            data_par = max(1, self.tpu_size // denom)
             self.mesh_shape = {}
             if data_par > 1:
                 self.mesh_shape["data"] = data_par
@@ -248,8 +263,17 @@ class ModelParameter:
                 self.mesh_shape["model"] = self.heads
             if self.sequence_parallel > 1:
                 self.mesh_shape["sequence"] = self.sequence_parallel
+            if self.pipeline_stages > 1:
+                self.mesh_shape["pipe"] = self.pipeline_stages
             if not self.mesh_shape:
                 self.mesh_shape = {"data": 1}
+        # pipeline_stages always mirrors the mesh's pipe axis (1 when absent)
+        self.pipeline_stages = self.mesh_shape.get("pipe", 1)
+        if self.pipeline_stages > 1 and self.depth % self.pipeline_stages:
+            raise ValueError(
+                f"depth={self.depth} must divide into pipe={self.pipeline_stages} stages")
+        if self.pipeline_microbatches is None:
+            self.pipeline_microbatches = self.pipeline_stages
         # dim-name -> mesh-axis layout rules ("batch:b,heads:h" analogue);
         # layout_override adds/replaces rules (e.g. {"experts": "model"} for
         # expert-parallel soft-MoE with replicated heads)
@@ -260,7 +284,9 @@ class ModelParameter:
             self.layout["heads"] = "model"
         if "sequence" in self.mesh_shape:
             self.layout["sequence"] = "sequence"
+        # a None value in layout_override deletes the rule (un-maps the dim)
         self.layout.update(self.layout_override)
+        self.layout = {k: v for k, v in self.layout.items() if v is not None}
 
         self.block_config = [BlockConfig(c, self.memory_reduction_strategy)
                              for c in self.block_config]

@@ -34,16 +34,20 @@ def build_mesh(params: ModelParameter,
     shape = dict(params.mesh_shape)
     model = shape.get("model", 1)
     seq = shape.get("sequence", 1)
-    while model * seq > ndev and model > 1:
+    pipe = shape.get("pipe", 1)
+    while model * seq * pipe > ndev and model > 1:
         model //= 2
-    while model * seq > ndev and seq > 1:
+    while model * seq * pipe > ndev and seq > 1:
         seq //= 2
-    data = max(1, ndev // (model * seq))
+    while model * seq * pipe > ndev and pipe > 1:
+        pipe //= 2
+    data = max(1, ndev // (model * seq * pipe))
     axes, sizes = [], []
-    for name, size in (("data", data), ("model", model), ("sequence", seq)):
+    for name, size in (("data", data), ("pipe", pipe), ("model", model),
+                       ("sequence", seq)):
         if name in shape or name == "data":
             axes.append(name)
-            sizes.append(size if name != "data" else data)
+            sizes.append(size)
     dev_array = np.asarray(devices[: int(np.prod(sizes))]).reshape(sizes)
     return Mesh(dev_array, tuple(axes))
 

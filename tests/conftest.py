@@ -6,12 +6,39 @@ NamedSharding layouts and collectives are exercised (SURVEY.md §4 notes the
 reference never tests multi-core behavior; we do).
 """
 import os
+import sys
 
-os.environ["PALLAS_AXON_POOL_IPS"] = ""  # make any jax re-init skip the axon TPU
-os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon TPU plugin registers itself from sitecustomize at interpreter
+# startup (it imports jax), so mutating os.environ here is too late once the
+# accelerator tunnel is live.  Re-exec pytest exactly once with a clean
+# CPU-only 8-device env instead; capture must be released first or the new
+# process's output lands in the dead process's capture file.
 flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# only the live axon plugin needs the re-exec; everywhere else jax is not yet
+# imported when this module loads, so in-process env mutation suffices
+_needs_reexec = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def pytest_configure(config):
+    if not _needs_reexec or os.environ.get("_HBNLP_TEST_REEXEC"):
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = dict(os.environ,
+               _HBNLP_TEST_REEXEC="1",
+               PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=flags + " --xla_force_host_platform_device_count=8")
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+
+if not _needs_reexec:
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -55,7 +55,6 @@ def moe_expert_parallel_test():
 
     params_b = make_params(layout_override={"experts": "model", "heads": None},
                            **cfg)
-    params_b.layout = {k: v for k, v in params_b.layout.items() if v}
     m_b = Model(params_b)
     mesh = shardlib.build_mesh(params_b)
     tr_b = Trainer(params_b, m_b, mesh=mesh)
