@@ -267,7 +267,13 @@ class ModelParameter:
                 self.mesh_shape["pipe"] = self.pipeline_stages
             if not self.mesh_shape:
                 self.mesh_shape = {"data": 1}
-        # pipeline_stages always mirrors the mesh's pipe axis (1 when absent)
+        # pipeline_stages always mirrors the mesh's pipe axis (1 when absent);
+        # an explicit request that the override mesh cannot honour is an error,
+        # not a silent fallback
+        if (self.mesh_shape_override and "pipe" not in self.mesh_shape
+                and self._raw_config.get("pipeline_stages", 1) > 1):
+            raise ValueError(
+                "pipeline_stages > 1 requires a 'pipe' axis in mesh_shape_override")
         self.pipeline_stages = self.mesh_shape.get("pipe", 1)
         if self.pipeline_stages > 1 and self.depth % self.pipeline_stages:
             raise ValueError(

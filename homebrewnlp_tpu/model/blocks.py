@@ -204,6 +204,12 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         subsets.append({n: ctx.params[n] for n in names})
     params.attention_idx = attn_idx
 
+    mesh = ctx.mesh
+    if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+        from ..parallel.pipeline import pipeline_body
+        return pipeline_body(params, mesh, fns, subsets, plan, src,
+                             strategy), plan
+
     if strategy == "revnet":
         x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src)
         return x1 + x2, plan

@@ -25,11 +25,13 @@ def pytest_configure(config):
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()
+    new_flags = flags if "host_platform_device_count" in flags \
+        else flags + " --xla_force_host_platform_device_count=8"
     env = dict(os.environ,
                _HBNLP_TEST_REEXEC="1",
                PALLAS_AXON_POOL_IPS="",
                JAX_PLATFORMS="cpu",
-               XLA_FLAGS=flags + " --xla_force_host_platform_device_count=8")
+               XLA_FLAGS=new_flags)
     os.execve(sys.executable,
               [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
 

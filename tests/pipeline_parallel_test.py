@@ -1,0 +1,118 @@
+"""Pipeline parallelism (GPipe over the 'pipe' mesh axis) parity tests.
+
+The reference has no pipeline parallelism (SURVEY.md §2.10); these tests pin
+the new capability: a pipelined train step must produce the same loss and the
+same updated parameters as the plain data-parallel step, for every memory
+strategy, since GPipe changes the schedule but not the math.
+"""
+import jax
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu.config import ModelParameter
+from homebrewnlp_tpu.core import sharding as shardlib
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer
+
+BLOCKS = [{"layer": ["norm-shift-scale-features-group",
+                     "feed_forward-relu"]},
+          {"layer": ["norm-shift-scale-features-group",
+                     "attention-dot_product-context"]}]
+
+
+def _cfg(**over):
+    cfg = dict(model_mode="gpt", sequence_length=32, features_per_head=16,
+               heads=4, depth=4, train_batch_size=8, vocab_size=64,
+               block_config=BLOCKS, calc_accuracy=False,
+               calculation_dtype="float32", storage_dtype="float32",
+               slice_dtype="float32", optimizer_slice_dtype="float32",
+               optimizer="momentum:0.9:1:0-learning_rate", learning_rate=0.05,
+               weight_decay=0.0, model_path="/tmp/pp_test")
+    cfg.update(over)
+    return cfg
+
+
+def _batch(params, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    return {"token_x": x, "token_y": (x + 1) % params.vocab_size}
+
+
+def _run_step(cfg_overrides, mesh_override):
+    params = ModelParameter(_cfg(**cfg_overrides,
+                                 mesh_shape_override=mesh_override))
+    model = Model(params)
+    mesh = shardlib.build_mesh(params)
+    trainer = Trainer(params, model, mesh=mesh)
+    batch = _batch(params)
+    state = trainer.init_state(batch)
+    state, metrics = trainer.step(state, batch)
+    loss = float(metrics["loss"])
+    varlist = {k: np.asarray(v) for k, v in state.variables.items()}
+    return loss, varlist, mesh
+
+
+@pytest.mark.parametrize("strategy", ["none", "checkpoint", "revnet", "momentum"])
+def pipeline_matches_plain_test(strategy):
+    loss_a, vars_a, _ = _run_step({"memory_reduction_strategy": strategy},
+                                  {"data": 2})
+    loss_b, vars_b, mesh = _run_step({"memory_reduction_strategy": strategy},
+                                     {"data": 2, "pipe": 4})
+    assert dict(mesh.shape)["pipe"] == 4
+    np.testing.assert_allclose(loss_a, loss_b, rtol=2e-5, atol=2e-5)
+    assert vars_a.keys() == vars_b.keys()
+    for k in vars_a:
+        np.testing.assert_allclose(vars_a[k], vars_b[k], rtol=2e-4, atol=2e-4,
+                                   err_msg=k)
+
+
+def pipeline_microbatches_test():
+    """More microbatches than stages still exact."""
+    loss_a, vars_a, _ = _run_step({"train_batch_size": 16}, {"data": 2})
+    loss_b, vars_b, _ = _run_step({"train_batch_size": 16,
+                                   "pipeline_microbatches": 4},
+                                  {"data": 2, "pipe": 2})
+    np.testing.assert_allclose(loss_a, loss_b, rtol=2e-5, atol=2e-5)
+    for k in vars_a:
+        np.testing.assert_allclose(vars_a[k], vars_b[k], rtol=2e-4, atol=2e-4,
+                                   err_msg=k)
+
+
+def pipeline_with_model_axis_test():
+    """pipe x model mesh: tensor parallelism nests inside each stage."""
+    loss_a, vars_a, _ = _run_step({}, {"data": 1})
+    loss_b, vars_b, _ = _run_step({}, {"pipe": 2, "model": 4})
+    np.testing.assert_allclose(loss_a, loss_b, rtol=2e-5, atol=2e-5)
+    for k in vars_a:
+        np.testing.assert_allclose(vars_a[k], vars_b[k], rtol=2e-4, atol=2e-4,
+                                   err_msg=k)
+
+
+def pipeline_rejects_bad_depth_test():
+    with pytest.raises(ValueError, match="divide into"):
+        ModelParameter(_cfg(depth=3, mesh_shape_override={"pipe": 2}))
+
+
+def pipeline_rejects_stale_stages_test():
+    """Explicit pipeline_stages with an override mesh lacking 'pipe' must
+    error, not silently run unpipelined."""
+    with pytest.raises(ValueError, match="pipe"):
+        ModelParameter(_cfg(pipeline_stages=4,
+                            mesh_shape_override={"data": 8}))
+
+
+def pipeline_with_dropout_test():
+    """Stochastic layers exercise the per-stage/per-tick rng fold."""
+    blocks = [{"layer": ["norm-shift-scale-features-group",
+                         "dropout-dropout_rate0.2", "feed_forward-relu"]}]
+    params = ModelParameter(_cfg(block_config=blocks,
+                                 mesh_shape_override={"data": 2, "pipe": 4}))
+    params.train = True
+    model = Model(params)
+    mesh = shardlib.build_mesh(params)
+    trainer = Trainer(params, model, mesh=mesh)
+    batch = _batch(params)
+    state = trainer.init_state(batch)
+    state, metrics = trainer.step(state, batch, jax.random.PRNGKey(7))
+    assert np.isfinite(float(metrics["loss"]))
