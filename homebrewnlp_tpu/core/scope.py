@@ -42,7 +42,8 @@ class Context:
 
     def __init__(self, mode: str, params: typing.Optional[Params] = None,
                  seed: int = 0, rng_key: typing.Optional[jax.Array] = None,
-                 record_touched: bool = False, mesh: typing.Any = None):
+                 record_touched: bool = False, mesh: typing.Any = None,
+                 decode: typing.Any = None):
         assert mode in ("init", "apply")
         self.mode = mode
         self.params: Params = {} if params is None else params
@@ -51,6 +52,8 @@ class Context:
         # jax.sharding.Mesh when running sharded; layers may specialise
         # (e.g. ring attention over a 'sequence' axis)
         self.mesh = mesh
+        # model.decode.DecodeState during incremental (KV-cached) decoding
+        self.decode = decode
         self.stack: typing.List[_Frame] = [_Frame("")]
         self.touched: typing.Optional[typing.List[str]] = [] if record_touched else None
         # name -> tuple[Dim] recorded at init; consumed by the optimizer's

@@ -60,8 +60,75 @@ def make_sampler(model: Model) -> typing.Callable:
     return sample
 
 
+def init_decode_caches(model: Model, variables, token_x) -> dict:
+    """Zero-filled cache pytree for ``make_kv_sampler`` (structure discovered
+    abstractly via eval_shape — no device compute)."""
+    tok0 = token_x[:, :1]
+    shapes = jax.eval_shape(
+        lambda v, t: model.apply_decode(v, t, jnp.int32(0), {})[1],
+        variables, tok0)
+    return {k: jnp.zeros(v.shape, v.dtype) for k, v in shapes.items()}
+
+
+def make_kv_sampler(model: Model) -> typing.Callable:
+    """KV-cached sampler: O(1) compute per token via ``Model.apply_decode``.
+
+    Replaces the reference's full-model-per-token while_loop
+    (/root/reference/src/run/inference.py:76-97 — an MTF artifact, see
+    SURVEY.md §7).  Greedy (temperature=0) output matches ``make_sampler``
+    exactly; for temperature>0 the distribution is identical but the gumbel
+    draw consumes [batch, 1, patch, vocab] noise per step instead of noise
+    over the full sequence, so individual samples differ from the
+    full-forward sampler's stream.
+
+    Loop identity with the full sampler: its iteration at ``position`` writes
+    token_x[position] from logits[position-1]; here step ``q`` consumes
+    token_x[q] and writes q+1 (when q+1 >= initial_pos), walking q from 0 so
+    caches fill causally through the prompt (prefill and decode share one
+    loop).
+    """
+    def sample(variables, token_x, initial_pos, temperature, end_iterations,
+               key, caches):
+        # iterations at position >= seq are no-ops in the full sampler (its
+        # one-hot write misses); clamp instead of letting the update clamp
+        end_iterations = jnp.minimum(end_iterations, token_x.shape[1])
+        # full-sampler parity: its first iteration at position 0 writes 0
+        # (the roll fills index 0 with zeros)
+        zero_first = (initial_pos == 0)
+        token_x = token_x.at[:, 0].set(
+            jnp.where(zero_first, jnp.zeros_like(token_x[:, 0]), token_x[:, 0]))
+
+        def cond_fn(state):
+            q, *_ = state
+            return q < end_iterations - 1
+
+        def body_fn(state):
+            q, token_x, caches, key = state
+            cur = jax.lax.dynamic_slice_in_dim(token_x, q, 1, axis=1)
+            logits, caches = model.apply_decode(variables, cur, q, caches)
+            logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
+            key, sub = jax.random.split(key)
+            u = jax.random.uniform(sub, logits.shape, jnp.float32,
+                                   minval=1e-9, maxval=1.0)
+            logits = logits + jnp.log(-jnp.log(u)) * (-temperature)
+            nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
+            old = jax.lax.dynamic_slice_in_dim(token_x, q + 1, 1, axis=1)
+            new = jnp.where(q + 1 >= initial_pos, nxt, old)
+            token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
+                                                          axis=1)
+            return q + 1, token_x, caches, key
+
+        q0 = jnp.asarray(0, jnp.int32)
+        _, token_x, _, _ = jax.lax.while_loop(
+            cond_fn, body_fn, (q0, token_x, caches, key))
+        return token_x
+
+    return sample
+
+
 def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
-                temperature=None, end_iterations=None, seed: int = 0):
+                temperature=None, end_iterations=None, seed: int = 0,
+                use_cache: bool = True):
     """Convenience host-level entry (pads/crops the prompt to sequence
     length); prompt_tokens: int array [batch, <=seq] or [batch, seq, patch]."""
     import numpy as np
@@ -81,6 +148,18 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
         temperature = params.sampling_temperature
     if end_iterations is None:
         end_iterations = seq
+    if use_cache and not params.use_video:
+        try:
+            caches = init_decode_caches(model, variables, jnp.asarray(token_x))
+            fn = jax.jit(make_kv_sampler(model))
+            out = fn(variables, jnp.asarray(token_x),
+                     jnp.asarray(initial_pos, jnp.int32),
+                     jnp.asarray(temperature, jnp.float32),
+                     jnp.asarray(end_iterations, jnp.int32),
+                     jax.random.PRNGKey(seed), caches)
+            return np.asarray(out)
+        except NotImplementedError:
+            pass  # layer without a streaming form: full-forward fallback
     fn = jax.jit(make_sampler(model))
     out = fn(variables, jnp.asarray(token_x), jnp.asarray(token_x),
              jnp.asarray(initial_pos, jnp.int32),

@@ -296,3 +296,35 @@ class Model:
             self.params.attention_idx = 0
             info, _ = build(self.params, *args, plan=self.plan)
         return info
+
+    def apply_decode(self, variables: typing.Dict[str, jax.Array],
+                     token_slice: jax.Array, pos: jax.Array,
+                     caches: typing.Dict[str, jax.Array],
+                     mesh: typing.Any = None
+                     ) -> typing.Tuple[jax.Array, typing.Dict[str, jax.Array]]:
+        """One incremental-decode step (model/decode.py).
+
+        ``token_slice``: the input token at ``pos``, shaped like token_x with
+        the sequence axis of length 1.  Returns (next-token logits at ``pos``
+        as [batch, 1, token_patch, vocab], updated caches).  Replaces the
+        reference sampler's full forward per token
+        (/root/reference/src/run/inference.py:76-97) with O(1)-per-step
+        compute; only valid for causal text models (use_video off).
+        """
+        from .decode import DecodeState
+        assert self.plan is not None, "call init() first (or assign .plan)"
+        p = self.params
+        assert not p.use_video and p.use_language, \
+            "incremental decode supports text (gpt) mode only"
+        state = DecodeState(jnp.asarray(pos, jnp.int32), p.sequence_dim.size,
+                            p.sequence_dim.name, caches)
+        ctx = scope.Context("apply", params=variables, mesh=mesh, decode=state)
+        decode_dims = [Dim(d.name, 1) if d.name == p.sequence_dim.name else d
+                       for d in p.token_dim_shape]
+        with scope.context(ctx):
+            tok = nt(token_slice, decode_dims)
+            tgt = nt(jnp.zeros_like(token_slice), decode_dims)
+            self.params.attention_idx = 0
+            info, _ = build(p, None, None, None, tok, tgt, None, None, None,
+                            plan=self.plan)
+        return info.token_out.data, state.out

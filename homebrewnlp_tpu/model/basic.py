@@ -100,7 +100,12 @@ def sum_heads(args: BlockArgs) -> NamedTensor:
 
 def transpose_sequence_features(args: BlockArgs) -> NamedTensor:
     """Swap sequence and feature axes (basic.py:81-86)."""
+    from . import decode as decode_mod
     params = args.params
+    if decode_mod.active() is not None:
+        raise NotImplementedError(
+            "transpose_sequence_features mixes sequence into features; "
+            "incremental decode falls back to the full-forward sampler")
     assert params.features_per_head == params.sequence_length, \
         "transpose_sequence_features requires features_per_head == sequence_length"
     tensor = rename_dim(args.tensor, params.sequence_dim.name, "intermediate")

@@ -59,11 +59,13 @@ class ReplayBlock:
     def __call__(self, subset: Subset, x: NamedTensor) -> NamedTensor:
         outer_rng = None
         outer_mesh = None
+        outer_decode = None
         if scope.in_context():
             outer_rng = scope.current().rng_key
             outer_mesh = scope.current().mesh
+            outer_decode = scope.current().decode
         ctx = scope.Context("apply", params=subset, rng_key=None,
-                            mesh=outer_mesh)
+                            mesh=outer_mesh, decode=outer_decode)
         if outer_rng is not None:
             ctx.rng_key = jax.random.fold_in(outer_rng,
                                              self.depth_idx * 131 + self.cfg_idx)
@@ -203,6 +205,26 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         attn_idx += sum(layer.split('-')[0] == "attention" for layer in bc.layer)
         subsets.append({n: ctx.params[n] for n in names})
     params.attention_idx = attn_idx
+
+    if ctx.decode is not None:
+        # no gradients at decode time: run the invertible-forward recurrences
+        # plainly (identical values; custom_vjp/checkpoint wrappers would only
+        # complicate the while_loop trace)
+        if strategy == "revnet":
+            x1 = x2 = src
+            for f, s in zip(fns, subsets):
+                x1, x2 = x2, x1 + f(s, x2)
+            return x1 + x2, plan
+        if strategy == "momentum":
+            x, v = src, src
+            for f, s in zip(fns, subsets):
+                v = v * params.momentumnet_alpha + f(s, x) * (1 - params.momentumnet_alpha)
+                x = x + v
+            return x + v, plan
+        out = src
+        for f, s in zip(fns, subsets):
+            out = f(s, out)
+        return out, plan
 
     mesh = ctx.mesh
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:

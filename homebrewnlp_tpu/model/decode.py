@@ -1,0 +1,130 @@
+"""Incremental (KV-cached) decoding support.
+
+The reference's sampler rebuilds the ENTIRE forward model every token inside
+an mtf.while_loop (/root/reference/src/run/inference.py:76-97) — an MTF
+artifact, O(seq * full-forward) per sample.  Here the same scoped model code
+runs on a length-1 sequence slice per step; the few sequence-mixing ops
+consult a ``DecodeState`` held on the scope Context:
+
+  * attention      — per-instance key/value caches updated at ``pos`` via
+                     ``spread`` (the decode analogue of ``anonymize``: instead
+                     of renaming the full-length dim, it scatters the current
+                     slice into a cached full-length ``_dim`` buffer),
+  * position embeds— built at full length, then row ``pos`` sliced out
+                     (model/embedding.py),
+  * causal masks   — ``compare_range`` evaluates the query range as ``[pos]``
+                     (model/utils.py),
+  * cumsum/cummean — running-total caches,
+  * convolution    — rolling input-window cache,
+  * revnet/momentum— plain invertible-forward recurrences (no custom_vjp
+                     needed without gradients; model/blocks.py).
+
+Cache keys are scope paths, so the deterministic hierarchical naming that
+makes parameter resolution replayable (core/scope.py) also makes the cache
+structure a stable pytree across while_loop iterations.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from ..core import scope
+from ..core.dims import Dim, anonymize_dim
+from ..core.tensor import NamedTensor, nt
+
+
+class DecodeState:
+    """Carried through one decode step: position + cache pytree in/out."""
+
+    def __init__(self, pos: jax.Array, seq_len: int, seq_name: str,
+                 caches: typing.Dict[str, jax.Array]):
+        self.pos = pos
+        self.seq_len = seq_len
+        self.seq_name = seq_name
+        self.caches = caches
+        self.out: typing.Dict[str, jax.Array] = dict(caches)
+
+
+def active() -> typing.Optional[DecodeState]:
+    if not scope.in_context():
+        return None
+    return getattr(scope.current(), "decode", None)
+
+
+def is_decode_dim(state: typing.Optional[DecodeState], dim: Dim) -> bool:
+    """True when ``dim`` is the length-1 stand-in for the full sequence."""
+    return (state is not None and dim.name == state.seq_name
+            and dim.size == 1 and state.seq_len != 1)
+
+
+def key_dim_for(state: typing.Optional[DecodeState], dim: Dim) -> Dim:
+    """The anonymized key-position dim: full-length under decode."""
+    if is_decode_dim(state, dim):
+        return anonymize_dim(dim, state.seq_len)
+    return anonymize_dim(dim)
+
+
+def _cache(name: str, shape: typing.Sequence[int], dtype) -> jax.Array:
+    state = active()
+    assert state is not None
+    if name in state.caches:
+        buf = state.caches[name]
+        assert buf.shape == tuple(shape), (name, buf.shape, shape)
+        return buf.astype(dtype)
+    return jnp.zeros(tuple(shape), dtype)
+
+
+def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
+    """Scatter the current slice into a full-length cached buffer.
+
+    ``x`` carries ``dim`` with size 1 (the current position); returns the
+    cache with that axis at full sequence length, renamed ``_dim`` — the
+    decode-time replacement for ``anonymize(x, dim)`` on the key/value side
+    of attention.
+    """
+    state = active()
+    assert state is not None and is_decode_dim(state, dim)
+    ctx = scope.current()
+    name = "cache/" + ctx.full_name("kv")
+    axis = x.axis(dim)
+    full_dims = [key_dim_for(state, d) if d == dim else d for d in x.dims]
+    buf = _cache(name, [d.size for d in full_dims], x.dtype)
+    buf = jax.lax.dynamic_update_slice_in_dim(buf, x.data, state.pos, axis)
+    state.out[name] = buf
+    return nt(buf, full_dims)
+
+
+def running_sum(x: NamedTensor) -> NamedTensor:
+    """total' = total + x; returns total' (decode-time cumsum over pos)."""
+    state = active()
+    assert state is not None
+    ctx = scope.current()
+    name = "cache/" + ctx.full_name("cumsum")
+    buf = _cache(name, [d.size for d in x.dims], x.data.dtype)
+    total = buf + x.data
+    state.out[name] = total
+    return nt(total, list(x.dims))
+
+
+def rolling_window(x: NamedTensor, dim: Dim, window: int) -> NamedTensor:
+    """Shift-and-append window cache over ``dim`` (causal conv decode).
+
+    ``x`` has ``dim`` size 1; returns the last ``window`` positions (zeros
+    beyond the start — exactly causal front-padding) with ``dim`` sized
+    ``window``, current position last.
+    """
+    state = active()
+    assert state is not None and is_decode_dim(state, dim)
+    ctx = scope.current()
+    name = "cache/" + ctx.full_name("convwin")
+    axis = x.axis(dim)
+    shape = [d.size for d in x.dims]
+    shape[axis] = window
+    buf = _cache(name, shape, x.dtype)
+    buf = jnp.concatenate(
+        [jax.lax.slice_in_dim(buf, 1, window, axis=axis), x.data], axis=axis)
+    state.out[name] = buf
+    dims = [Dim(d.name, window) if d == dim else d for d in x.dims]
+    return nt(buf, dims)

@@ -73,6 +73,29 @@ def _relative(args: BlockArgs, shape: typing.List[Dim]) -> NamedTensor:
 def _embed(args: BlockArgs, shape: SHAPE) -> NamedTensor:
     shape = list(shape)
     params = args.params
+
+    # Incremental decoding: position embeddings are parameters over the FULL
+    # sequence; a length-1 query dim in the requested shape means "row pos" —
+    # build at full length (so parameter names/shapes match training) and
+    # slice the row out afterwards (model/decode.py).
+    from . import decode as decode_mod
+    state = decode_mod.active()
+    sliced_axes = [i for i, d in enumerate(shape)
+                   if decode_mod.is_decode_dim(state, d)]
+    if sliced_axes:
+        import jax.lax
+        full_shape = [Dim(d.name, state.seq_len) if i in sliced_axes else d
+                      for i, d in enumerate(shape)]
+        out = _embed(args, full_shape)
+        # out's dim order may differ from the request (axial reshapes);
+        # slice every full-length stand-in wherever it landed
+        data = out.data
+        out_dims = list(out.dims)
+        for i in sliced_axes:
+            axis = out_dims.index(full_shape[i])
+            data = jax.lax.dynamic_slice_in_dim(data, state.pos, 1, axis=axis)
+            out_dims[axis] = shape[i]
+        return nt(data, out_dims)
     position_dims = shape_sub(shape_sub(shape, params.feature_dims), params.intermediate)
     feature_dims = linear_shapes(args).old
 

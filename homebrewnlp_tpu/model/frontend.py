@@ -36,10 +36,15 @@ def convolution(args: BlockArgs) -> NamedTensor:
     from .backend import orthogonal_var
     from .utils import get_attention_dim, is_masked
 
+    from . import decode as decode_mod
+
     params = args.params
     dim = get_attention_dim(args).dim
     masked = is_masked(args)
-    kernel = min(params.convolution_size, dim.size)
+    state = decode_mod.active()
+    decoding = decode_mod.is_decode_dim(state, dim)
+    full_len = state.seq_len if decoding else dim.size
+    kernel = min(params.convolution_size, full_len)
     feature_dims = list(params.feature_dims)
     kernel_dim_in = [Dim("_conv_in", shape_size(feature_dims))]
     canonical = [d for d in args.tensor.dims if d not in feature_dims and d != dim] \
@@ -48,17 +53,25 @@ def convolution(args: BlockArgs) -> NamedTensor:
     lead = shape_size(canonical[:-1 - len(feature_dims)])
     features = shape_size(feature_dims)
     data = x.data.reshape(lead, dim.size, features)
-    if masked:
-        data = jnp.pad(data, ((0, 0), (kernel - 1, 0), (0, 0)))
-        padding = "VALID"
-    else:
-        padding = "SAME"
     w = orthogonal_var(args, [Dim("_conv_k", kernel)] + kernel_dim_in
                        + feature_dims, kernel_dim_in)
     wdata = w.data.reshape(kernel, features, features)
-    out = jax.lax.conv_general_dilated(
-        data, wdata, window_strides=(1,), padding=padding,
-        dimension_numbers=("NWC", "WIO", "NWC"))
+    if decoding:
+        if not masked:
+            raise NotImplementedError("incremental decode needs causal conv")
+        xw = decode_mod.rolling_window(
+            nt(data, [Dim("_lead", lead), dim, Dim("_feat", features)]),
+            dim, kernel)
+        out = jnp.einsum("lkf,kfo->lo", xw.data, wdata)[:, None]
+    else:
+        if masked:
+            data = jnp.pad(data, ((0, 0), (kernel - 1, 0), (0, 0)))
+            padding = "VALID"
+        else:
+            padding = "SAME"
+        out = jax.lax.conv_general_dilated(
+            data, wdata, window_strides=(1,), padding=padding,
+            dimension_numbers=("NWC", "WIO", "NWC"))
     out = nt(out.reshape([d.size for d in canonical]).astype(args.tensor.dtype),
              canonical)
     return transpose_to(out, args.tensor.dims)

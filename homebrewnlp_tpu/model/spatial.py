@@ -17,10 +17,24 @@ from ..core.dims import Dim, shape_sub
 from ..core.tensor import (NamedTensor, cumsum as tensor_cumsum, einsum, exp,
                            less, multiply, range_, reduce_max, reduce_sum,
                            stop_gradient, greater_equal)
+from . import decode as decode_mod
 from .basic import activated_linear_in, activated_linear_out
 from .embedding import embed
-from .utils import (anonymize, anonymize_dim, compare_range, get_attention_dim,
+from .utils import (anonymize, compare_range, get_attention_dim,
                     is_masked, linear_shapes)
+
+
+def _key_dim(dim: Dim) -> Dim:
+    """Anonymized key-position dim; full-length under incremental decode."""
+    return decode_mod.key_dim_for(decode_mod.active(), dim)
+
+
+def _anonymize_kv(x: NamedTensor, dim: Dim) -> NamedTensor:
+    """anonymize() at train time; KV-cache scatter at decode time."""
+    state = decode_mod.active()
+    if decode_mod.is_decode_dim(state, dim):
+        return decode_mod.spread(x, dim)
+    return anonymize(x, dim)
 
 
 def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
@@ -36,6 +50,8 @@ def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     ctx = scope_mod.current()
     mesh = ctx.mesh
     params = args.params
+    if ctx.decode is not None:
+        return None
     if (mesh is None or "sequence" not in getattr(mesh, "axis_names", ())
             or mesh.shape["sequence"] <= 1 or dim.name != "sequence"):
         return None
@@ -74,18 +90,28 @@ def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
 
 def _masked_map(args: BlockArgs) -> typing.Tuple[NamedTensor, typing.Union[NamedTensor, int]]:
     dim = get_attention_dim(args).dim
-    tmp = anonymize_dim(dim)
+    tmp = _key_dim(dim)
     bias = embed(args, [args.params.head_dim, dim, tmp])
     return bias, (compare_range(args.params, dim, tmp, greater_equal)
                   if is_masked(args) else 1)
 
 
 def cumsum(args: BlockArgs) -> NamedTensor:
-    return tensor_cumsum(args.tensor, get_attention_dim(args).dim)
+    dim = get_attention_dim(args).dim
+    state = decode_mod.active()
+    if decode_mod.is_decode_dim(state, dim):
+        return decode_mod.running_sum(args.tensor)
+    return tensor_cumsum(args.tensor, dim)
 
 
 def cummean(args: BlockArgs) -> NamedTensor:
     dim = get_attention_dim(args).dim
+    state = decode_mod.active()
+    if decode_mod.is_decode_dim(state, dim):
+        import jax.numpy as jnp
+        from ..core.tensor import nt
+        return cumsum(args) / nt(jnp.asarray(1 + state.pos,
+                                             args.tensor.data.dtype), ())
     return cumsum(args) / (1 + range_(dim, args.tensor.dtype))
 
 
@@ -97,7 +123,7 @@ def attention(args: BlockArgs) -> NamedTensor:
         base = args(activated_linear_in(args))
 
     dim = get_attention_dim(args).dim
-    tmp = anonymize_dim(dim)
+    tmp = _key_dim(dim)
     shape = list(args.tensor.dims)
 
     logit: typing.Union[NamedTensor, int] = 0
@@ -110,13 +136,13 @@ def attention(args: BlockArgs) -> NamedTensor:
             key = key + embed(args, [dim] + list(params.feature_dims)) if \
                 isinstance(key, NamedTensor) else embed(args, [dim] + list(params.feature_dims))
         qry = activated_linear_out(base)
-        qry = qry * dim.size ** -0.5
+        qry = qry * tmp.size ** -0.5  # full length also under decode (dim is the length-1 slice)
         ring_out = _maybe_ring_attention(args, dim, qry, key, base)
         if ring_out is not None:
             return ring_out
         logit_shape = shape_sub(shape, shape_sub(linear_shapes(args).old,
                                                  [params.head_dim])) + [tmp]
-        logit = einsum([qry, anonymize(key, dim)], output_shape=logit_shape)
+        logit = einsum([qry, _anonymize_kv(key, dim)], output_shape=logit_shape)
         if "shared_key_value" in args.name_extras:
             val = key
     if "biased_softmax" in args.name_extras:
@@ -131,8 +157,8 @@ def attention(args: BlockArgs) -> NamedTensor:
     if "scale_attention_map" in args.name_extras:
         logit = logit * multiply(*_masked_map(args))
     if not isinstance(val, NamedTensor):
-        val = anonymize(args.tensor if "input_as_value" in args.name_extras
-                        else activated_linear_out(base), dim)
+        val = _anonymize_kv(args.tensor if "input_as_value" in args.name_extras
+                            else activated_linear_out(base), dim)
     if not isinstance(logit, NamedTensor):
         raise UserWarning(f"no spatial mixing with attention parameters: {args.name_extras}")
     return einsum([logit, val], shape)

@@ -81,8 +81,19 @@ def feature_dims_used(params: ModelParameter, shape: SHAPE,
 def compare_range(params: ModelParameter, dim0: Dim, dim1: Dim,
                   comparison) -> NamedTensor:
     """comparison(range(dim0), range(dim1)) as activation dtype — causal masks
-    (reference: src/utils_mtf.py:411-415)."""
-    return cast(comparison(range_(dim0, jnp.int32), range_(dim1, jnp.int32)),
+    (reference: src/utils_mtf.py:411-415).  Under incremental decoding the
+    length-1 query dim evaluates as ``[pos]`` so masks select row pos."""
+    from ..core.tensor import nt
+    from . import decode
+
+    state = decode.active()
+
+    def _range(d: Dim) -> NamedTensor:
+        if decode.is_decode_dim(state, d):
+            return nt(state.pos[None].astype(jnp.int32), [d])
+        return range_(d, jnp.int32)
+
+    return cast(comparison(_range(dim0), _range(dim1)),
                 params.calculation_dtype)
 
 
