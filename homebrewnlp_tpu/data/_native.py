@@ -1,0 +1,58 @@
+"""Shared loader for the C++ fast paths (native/*.cpp via ctypes).
+
+pybind11 isn't available in this image, so native modules are plain C symbols
+compiled with g++ on demand and loaded with ctypes; callers degrade to pure
+python when the toolchain or .so is missing.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import typing
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_lock = threading.Lock()
+_cache: typing.Dict[str, typing.Optional[ctypes.CDLL]] = {}
+
+
+def _build(src: str, so: str, extra: typing.Sequence[str]) -> bool:
+    try:
+        subprocess.run(["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                        src, "-o", so, *extra], check=True,
+                       capture_output=True, timeout=300)
+        return True
+    except Exception:
+        return False
+
+
+def load_library(name: str,
+                 declare: typing.Callable[[ctypes.CDLL], None],
+                 extra_flags: typing.Sequence[str] = ()
+                 ) -> typing.Optional[ctypes.CDLL]:
+    """Load native/<name>.cpp as native/lib<name>.so, building when the
+    source is newer than the binary.  `declare` sets restype/argtypes.
+    Results (including failure) are cached per module."""
+    src = os.path.join(NATIVE_DIR, f"{name}.cpp")
+    so = os.path.join(NATIVE_DIR, f"lib{name}.so")
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        _cache[name] = None
+        stale = (os.path.exists(src)
+                 and (not os.path.exists(so)
+                      or os.path.getmtime(so) < os.path.getmtime(src)))
+        if stale and not _build(src, so, extra_flags):
+            return None
+        if not os.path.exists(so):
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            declare(lib)
+        except (OSError, AttributeError):
+            return None
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,124 @@
+"""ctypes bindings for native/bpe_trainer.cpp (built on demand with g++).
+
+The native trainer produces a discovered alphabet (codepoints >= 256) plus an
+ordered merge list; this module turns that into a HuggingFace-format
+``tokenizer.json`` with the reference's construction
+(/root/reference/scripts/train_tokenizer.pyx:180-188): unk token chr(1), the
+256 single-byte tokens chr(0..255) as ids 0..255, and the "isolated"
+digits/whitespace/punctuation Split pre-tokenizer.  Training and encoding
+both operate on unicode codepoints, so the file loads with ``tokenizers``
+and tokenizes identically to how it was trained.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import string
+import tempfile
+import typing
+
+from ._native import load_library
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.bpe_train.restype = ctypes.c_long
+    lib.bpe_train.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+                              ctypes.c_long, ctypes.c_char_p]
+
+
+def _load() -> typing.Optional[ctypes.CDLL]:
+    return load_library("bpe_trainer", _declare, extra_flags=("-pthread",))
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class TrainResult(typing.NamedTuple):
+    alphabet: typing.List[typing.Tuple[int, int]]  # (codepoint, id), ids 256+
+    merges: typing.List[typing.Tuple[int, int]]    # (left_id, right_id)
+
+
+def train_merges(paths: typing.Sequence[str], vocab_size: int,
+                 min_frequency: int = 1, n_threads: int = 4) -> TrainResult:
+    """Run the native trainer; merge-token ids continue after the alphabet."""
+    lib = _load()
+    assert lib is not None, "native BPE trainer unavailable"
+    with tempfile.NamedTemporaryFile(suffix=".merges", delete=False) as tmp:
+        out_path = tmp.name
+    try:
+        n = lib.bpe_train("\n".join(paths).encode(), vocab_size, min_frequency,
+                          n_threads, out_path.encode())
+        if n < 0:
+            raise RuntimeError(f"bpe_train failed ({n})")
+        alphabet, merges = [], []
+        with open(out_path) as f:
+            for line in f:
+                kind, x, y, *_rest = line.split()
+                if kind == "A":
+                    alphabet.append((int(x), int(y)))
+                else:
+                    merges.append((int(x), int(y)))
+        assert len(merges) == n
+        return TrainResult(alphabet, merges)
+    finally:
+        os.unlink(out_path)
+
+
+def split_regex() -> str:
+    """The reference's isolated-split pattern (digits/whitespace/punct)."""
+    split_chars = string.digits + " \t\n\r\x0b\x0c"
+    for c in string.punctuation:
+        split_chars += "\\" + c
+    return f"[{split_chars}]|[^{split_chars}]+"
+
+
+def to_tokenizer_json(result: TrainResult) -> dict:
+    """HF-format tokenizer dict: byte ids 0..255, discovered alphabet, then
+    ordered merges."""
+    token_str: typing.List[str] = [chr(i) for i in range(256)]
+    for cp, idx in result.alphabet:
+        assert idx == len(token_str), "alphabet ids must be dense"
+        token_str.append(chr(cp))
+    merge_strs = []
+    for a, b in result.merges:
+        merge_strs.append(f"{token_str[a]} {token_str[b]}")
+        token_str.append(token_str[a] + token_str[b])
+    vocab = {}
+    for i, s in enumerate(token_str):
+        vocab.setdefault(s, i)
+    return {
+        "version": "1.0",
+        "truncation": None,
+        "padding": None,
+        "added_tokens": [
+            {"id": 1, "content": "\x01", "single_word": False,
+             "lstrip": False, "rstrip": False, "normalized": False,
+             "special": True}],
+        "normalizer": None,
+        "pre_tokenizer": {"type": "Split",
+                          "pattern": {"Regex": split_regex()},
+                          "behavior": "Isolated", "invert": False},
+        "post_processor": None,
+        "decoder": None,
+        "model": {"type": "BPE", "dropout": None, "unk_token": "\x01",
+                  "continuing_subword_prefix": None,
+                  "end_of_word_suffix": None, "fuse_unk": False,
+                  "byte_fallback": False, "ignore_merges": False,
+                  "vocab": vocab, "merges": merge_strs},
+    }
+
+
+def train_tokenizer_file(paths: typing.Sequence[str], vocab_size: int,
+                         output: str, min_frequency: int = 1,
+                         n_threads: int = 4) -> int:
+    """Full pipeline: native merge training -> tokenizer.json.  Returns the
+    final vocab size."""
+    result = train_merges(paths, vocab_size, min_frequency, n_threads)
+    doc = to_tokenizer_json(result)
+    tmp = output + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(doc, indent=4))
+    os.replace(tmp, output)
+    return 256 + len(result.alphabet) + len(result.merges)

@@ -8,57 +8,36 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 import typing
 
 import numpy as np
 
-_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_ROOT, "native", "recordio.cpp")
-_SO = os.path.join(_ROOT, "native", "librecordio.so")
-_lock = threading.Lock()
-_lib: typing.Optional[ctypes.CDLL] = None
-_tried = False
+from ._native import load_library
 
 
-def _build() -> bool:
-    try:
-        subprocess.run(["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                        _SRC, "-o", _SO], check=True, capture_output=True,
-                       timeout=120)
-        return True
-    except Exception:
-        return False
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.rio_scan.restype = ctypes.c_long
+    lib.rio_scan.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                             ctypes.c_void_p, ctypes.c_long]
+    lib.rio_read_file.restype = ctypes.c_long
+    lib.rio_read_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long]
+    lib.rio_decode_varints.restype = ctypes.c_long
+    lib.rio_decode_varints.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                       ctypes.c_void_p, ctypes.c_long]
+    lib.rio_find_feature.restype = ctypes.c_long
+    lib.rio_find_feature.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                     ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_void_p]
+    lib.rio_masked_crc.restype = ctypes.c_uint32
+    lib.rio_masked_crc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rio_write_records.restype = ctypes.c_long
+    lib.rio_write_records.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                      ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_long, ctypes.c_int]
 
 
 def _load() -> typing.Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            if not os.path.exists(_SRC) or not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        lib.rio_scan.restype = ctypes.c_long
-        lib.rio_scan.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
-                                 ctypes.c_void_p, ctypes.c_long]
-        lib.rio_read_file.restype = ctypes.c_long
-        lib.rio_read_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long]
-        lib.rio_decode_varints.restype = ctypes.c_long
-        lib.rio_decode_varints.argtypes = [ctypes.c_void_p, ctypes.c_long,
-                                           ctypes.c_void_p, ctypes.c_long]
-        lib.rio_find_feature.restype = ctypes.c_long
-        lib.rio_find_feature.argtypes = [ctypes.c_void_p, ctypes.c_long,
-                                         ctypes.c_char_p, ctypes.c_void_p,
-                                         ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    return load_library("recordio", _declare)
 
 
 def available() -> bool:
@@ -83,6 +62,32 @@ def read_records(path: str) -> typing.Iterator[bytes]:
     for i in range(n):
         o, l = int(offsets[i]), int(lengths[i])
         yield data[o:o + l]
+
+
+def masked_crc(data: bytes) -> typing.Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.rio_masked_crc(buf.ctypes.data if len(data) else None,
+                                  len(data)))
+
+
+def write_records(path: str, payloads: typing.Sequence[bytes],
+                  append: bool = False) -> bool:
+    """Bulk framed-record write (crc32c framing in C++)."""
+    lib = _load()
+    if lib is None:
+        return False
+    buf = np.frombuffer(b"".join(payloads), dtype=np.uint8)
+    lengths = np.asarray([len(p) for p in payloads], dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]]) \
+        if len(payloads) else np.zeros(0, dtype=np.int64)
+    offsets = offsets.astype(np.int64)
+    n = lib.rio_write_records(path.encode(), buf.ctypes.data,
+                              offsets.ctypes.data, lengths.ctypes.data,
+                              len(payloads), int(append))
+    return n == len(payloads)
 
 
 def feature_tokens(payload: bytes, name: str = "text"

@@ -46,6 +46,10 @@ def crc32c(data: bytes) -> int:
 
 
 def masked_crc(data: bytes) -> int:
+    from . import native_recordio
+    crc = native_recordio.masked_crc(data)  # None when the lib is missing
+    if crc is not None:
+        return crc
     crc = crc32c(data)
     return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
 
@@ -166,19 +170,53 @@ def decode_example(data: bytes) -> typing.Dict[str, typing.Union[bytes, np.ndarr
 # ---- record-level I/O ----------------------------------------------------
 
 class RecordWriter:
+    """Framed-record writer; payloads are buffered and flushed in bulk
+    through the C++ fast path (native/recordio.cpp rio_write_records) when
+    available, else written with the python crc."""
+
+    _FLUSH_BYTES = 8 << 20
+
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._f = open(path, "wb")
+        self._path = os.path.abspath(path)
+        from . import native_recordio
+        self._native = native_recordio.available()
+        self._pending: typing.List[bytes] = []
+        self._pending_bytes = 0
+        self._started = False
+        self._f = None if self._native else open(path, "wb")
 
     def write(self, payload: bytes):
+        if self._native:
+            self._pending.append(bytes(payload))
+            self._pending_bytes += len(payload)
+            if self._pending_bytes >= self._FLUSH_BYTES:
+                self.flush()
+            return
         header = struct.pack("<Q", len(payload))
         self._f.write(header)
         self._f.write(struct.pack("<I", masked_crc(header)))
         self._f.write(payload)
         self._f.write(struct.pack("<I", masked_crc(payload)))
 
+    def flush(self):
+        """Write buffered records to disk (both paths durable after this)."""
+        if self._native:
+            if self._pending or not self._started:
+                from . import native_recordio
+                ok = native_recordio.write_records(self._path, self._pending,
+                                                   append=self._started)
+                if not ok:
+                    raise IOError(f"native record write failed: {self._path}")
+                self._started = True
+                self._pending, self._pending_bytes = [], 0
+        else:
+            self._f.flush()
+
     def close(self):
-        self._f.close()
+        self.flush()
+        if not self._native:
+            self._f.close()
 
     def __enter__(self):
         return self
@@ -201,10 +239,16 @@ def read_records(path: str, verify_crc: bool = False
                 return
             (length,) = struct.unpack("<Q", header[:8])
             payload = f.read(length)
-            f.read(4)  # payload crc
+            footer = f.read(4)
             if len(payload) < length:
                 return
             if verify_crc:
                 (expect,) = struct.unpack("<I", header[8:12])
-                assert masked_crc(header[:8]) == expect, f"corrupt header in {path}"
+                if masked_crc(header[:8]) != expect:
+                    raise IOError(f"corrupt record header in {path}")
+                if len(footer) < 4:
+                    raise IOError(f"truncated record footer in {path}")
+                (pexpect,) = struct.unpack("<I", footer)
+                if masked_crc(payload) != pexpect:
+                    raise IOError(f"corrupt record payload in {path}")
             yield payload

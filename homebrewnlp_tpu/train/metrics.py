@@ -60,7 +60,7 @@ class SummaryWriter:
         self._writer.write(encode_scalar_event(step, tag, value))
 
     def flush(self):
-        self._writer._f.flush()
+        self._writer.flush()
 
     def close(self):
         self._writer.close()

@@ -1,0 +1,353 @@
+// Native byte-level BPE tokenizer trainer.
+//
+// The reference trains its 65,536-token BPE with a gcc-compiled Cython module
+// (/root/reference/scripts/train_tokenizer.pyx) around the HuggingFace
+// trainer; this is the rebuild's native equivalent: the full trainer — word
+// counting, pair statistics, and incremental merge updates — in C++, exposed
+// as plain C symbols for ctypes (no pybind11 in this image).
+//
+// Semantics mirror the reference's tokenizer construction
+// (train_tokenizer.pyx:180-188): the corpus is pre-tokenized with the
+// "isolated" split — every ASCII digit / whitespace / punctuation byte is its
+// own pre-token, maximal runs of all other bytes form words — and the
+// initial alphabet is the 256 single bytes (the reference's chr(0..255)
+// special tokens).  Training is classic BPE: repeatedly merge the most
+// frequent adjacent symbol pair, maintaining pair counts incrementally (only
+// words containing the merged pair are touched) with a lazy max-heap, so the
+// merge loop is O(touched words) per step rather than a full recount.
+//
+// Build: g++ -O3 -march=native -shared -fPIC bpe_trainer.cpp -o libbpe.so -pthread
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// "isolated" split classes: ASCII digits, whitespace, punctuation each form
+// a single-byte pre-token; everything else (incl. bytes >= 128) is a word
+// byte.  Matches string.digits + whitespace + string.punctuation.
+bool is_split_byte(unsigned char b) {
+    if (b >= '0' && b <= '9') return true;
+    switch (b) {
+        case ' ': case '\t': case '\n': case '\r': case '\v': case '\f':
+            return true;
+        default: break;
+    }
+    // ASCII punctuation: 33-47, 58-64, 91-96, 123-126
+    if ((b >= 33 && b <= 47) || (b >= 58 && b <= 64) ||
+        (b >= 91 && b <= 96) || (b >= 123 && b <= 126)) return true;
+    return false;
+}
+
+using WordCounts = std::unordered_map<std::string, int64_t>;
+
+struct Range {
+    const std::string* path;
+    int64_t start, end;  // [start, end) plus the word spanning `end`
+};
+
+// Count pre-token words of one byte range.  A word spanning `end` belongs to
+// this range (we read past end to finish it); a word spanning `start`
+// belongs to the previous range (we skip to the first split byte unless the
+// byte at start-1 is already a boundary).  Split bytes are single-byte
+// pre-tokens, but one-symbol words never produce pairs, so they're skipped.
+// Boundary bytes are ASCII, so ranges never cut UTF-8 sequences ambiguously.
+bool count_range(const Range& r, WordCounts& out) {
+    FILE* f = fopen(r.path->c_str(), "rb");
+    if (!f) return false;
+    bool skipping = false;
+    int64_t pos = r.start;
+    if (r.start > 0) {
+        if (fseek(f, (long)(r.start - 1), SEEK_SET) != 0) { fclose(f); return false; }
+        int prev = fgetc(f);
+        if (prev == EOF) { fclose(f); return true; }
+        skipping = !is_split_byte((unsigned char)prev);
+    }
+    std::vector<unsigned char> buf(1 << 20);
+    std::string word;
+    bool done = false;
+    while (!done) {
+        size_t got = fread(buf.data(), 1, buf.size(), f);
+        if (got == 0) break;
+        for (size_t i = 0; i < got; i++, pos++) {
+            unsigned char b = buf[i];
+            if (is_split_byte(b)) {
+                if (skipping) {
+                    skipping = false;
+                } else if (word.size() > 1) {
+                    out[word]++;
+                }
+                word.clear();
+                // a word owns the range its first byte is in; anything after
+                // this boundary starts at pos+1
+                if (pos + 1 >= r.end) { done = true; break; }
+            } else if (!skipping) {
+                word.push_back((char)b);
+            } else if (pos >= r.end) {
+                // the skipped word extends past our range: nothing left for us
+                done = true;
+                word.clear();
+                break;
+            }
+        }
+    }
+    if (word.size() > 1) out[word]++;
+    fclose(f);
+    return true;
+}
+
+int64_t file_size(const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return -1;
+    fseek(f, 0, SEEK_END);
+    int64_t size = ftell(f);
+    fclose(f);
+    return size;
+}
+
+inline uint64_t pack(int32_t a, int32_t b) {
+    return ((uint64_t)(uint32_t)a << 32) | (uint32_t)b;
+}
+
+// Decode one UTF-8 codepoint at s[i]; on malformed input falls back to the
+// single byte's value (latin-1 style), so arbitrary bytes still train.
+uint32_t decode_utf8(const std::string& s, size_t& i) {
+    unsigned char b = (unsigned char)s[i];
+    if (b < 0x80) { i++; return b; }
+    int n = (b >= 0xF0) ? 4 : (b >= 0xE0) ? 3 : (b >= 0xC0) ? 2 : 1;
+    if (n == 1 || i + (size_t)n > s.size()) { i++; return b; }
+    uint32_t cp = b & (0x7Fu >> n);
+    for (int k = 1; k < n; k++) {
+        unsigned char c = (unsigned char)s[i + k];
+        if ((c & 0xC0) != 0x80) { i++; return b; }
+        cp = (cp << 6) | (c & 0x3F);
+    }
+    i += (size_t)n;
+    return cp;
+}
+
+struct HeapEntry {
+    int64_t count;
+    uint64_t pair;
+    bool operator<(const HeapEntry& o) const {
+        if (count != o.count) return count < o.count;
+        return pair > o.pair;  // deterministic: lower pair id wins ties
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Train BPE merges over newline-joined `paths`.  Pre-tokens are split on raw
+// bytes (the split classes are pure ASCII, so byte and codepoint splitting
+// agree on UTF-8 input); initial symbols are unicode codepoints — ids 0..255
+// fixed (the chr(0..255) specials), higher codepoints assigned ids 256+ in
+// sorted order ("A <codepoint> <id>" lines), then merges ("M left right
+// count" lines) continue the id space in merge order.  Returns the number of
+// merges, or negative on error (-1 bad args / open failure, -2 no trainable
+// words).
+long bpe_train(const char* paths_joined, long vocab_size, long min_frequency,
+               long n_threads, const char* out_path) {
+    if (!paths_joined || !out_path || vocab_size <= 256) return -1;
+    std::vector<std::string> paths;
+    {
+        const char* p = paths_joined;
+        while (*p) {
+            const char* nl = strchr(p, '\n');
+            size_t len = nl ? (size_t)(nl - p) : strlen(p);
+            if (len) paths.emplace_back(p, len);
+            p += len + (nl ? 1 : 0);
+        }
+    }
+    if (paths.empty()) return -1;
+    if (n_threads <= 0) n_threads = 1;
+
+    // ---- parallel word counting over byte ranges -------------------------
+    // files are split into ~equal ranges aligned at split-byte boundaries by
+    // count_range's ownership rule, so a single big corpus file still uses
+    // every thread
+    std::vector<Range> ranges;
+    {
+        int64_t total = 0;
+        std::vector<int64_t> sizes(paths.size());
+        for (size_t i = 0; i < paths.size(); i++) {
+            sizes[i] = file_size(paths[i]);
+            if (sizes[i] < 0) return -1;
+            total += sizes[i];
+        }
+        int64_t chunk = total / (4 * n_threads) + 1;
+        if (chunk < (1 << 20)) chunk = 1 << 20;
+        for (size_t i = 0; i < paths.size(); i++) {
+            for (int64_t start = 0; start < sizes[i]; start += chunk) {
+                int64_t end = start + chunk < sizes[i] ? start + chunk : sizes[i];
+                ranges.push_back({&paths[i], start, end});
+            }
+        }
+    }
+    WordCounts words;
+    {
+        std::mutex mu;
+        std::atomic<size_t> next{0};
+        std::atomic<bool> ok{true};
+        std::vector<std::thread> threads;
+        long nt = n_threads < (long)ranges.size() ? n_threads : (long)ranges.size();
+        for (long t = 0; t < nt; t++) {
+            threads.emplace_back([&]() {
+                WordCounts local;
+                while (true) {
+                    size_t i = next.fetch_add(1);
+                    if (i >= ranges.size()) break;
+                    if (!count_range(ranges[i], local)) ok = false;
+                }
+                std::lock_guard<std::mutex> lock(mu);
+                for (auto& kv : local) words[kv.first] += kv.second;
+            });
+        }
+        for (auto& th : threads) th.join();
+        if (!ok) return -1;
+    }
+    if (words.empty()) return -2;
+
+    // ---- alphabet: codepoints >= 256 get ids 256+ in sorted order ----------
+    std::vector<uint32_t> high_cps;
+    {
+        std::unordered_map<uint32_t, char> seen;
+        for (auto& kv : words) {
+            const std::string& w = kv.first;
+            for (size_t i = 0; i < w.size();) {
+                uint32_t cp = decode_utf8(w, i);
+                if (cp >= 256 && !seen.count(cp)) {
+                    seen[cp] = 1;
+                    high_cps.push_back(cp);
+                }
+            }
+        }
+        std::sort(high_cps.begin(), high_cps.end());
+    }
+    std::unordered_map<uint32_t, int32_t> cp_to_id;
+    for (size_t i = 0; i < high_cps.size(); i++)
+        cp_to_id[high_cps[i]] = (int32_t)(256 + i);
+
+    // ---- pair statistics ---------------------------------------------------
+    size_t n_words = words.size();
+    std::vector<std::vector<int32_t>> syms(n_words);
+    std::vector<int64_t> wcount(n_words);
+    {
+        size_t i = 0;
+        for (auto& kv : words) {
+            const std::string& w = kv.first;
+            syms[i].reserve(w.size());
+            for (size_t j = 0; j < w.size();) {
+                uint32_t cp = decode_utf8(w, j);
+                syms[i].push_back(cp < 256 ? (int32_t)cp : cp_to_id[cp]);
+            }
+            wcount[i] = kv.second;
+            i++;
+        }
+        words.clear();
+    }
+
+    std::unordered_map<uint64_t, int64_t> pair_count;
+    std::unordered_map<uint64_t, std::vector<int32_t>> pair_words;
+    pair_count.reserve(1 << 20);
+    for (size_t w = 0; w < n_words; w++) {
+        const auto& s = syms[w];
+        for (size_t i = 0; i + 1 < s.size(); i++) {
+            uint64_t pr = pack(s[i], s[i + 1]);
+            pair_count[pr] += wcount[w];
+            auto& vec = pair_words[pr];
+            if (vec.empty() || vec.back() != (int32_t)w) vec.push_back((int32_t)w);
+        }
+    }
+
+    std::priority_queue<HeapEntry> heap;
+    for (auto& kv : pair_count) heap.push({kv.second, kv.first});
+    if (min_frequency < 1) min_frequency = 1;
+
+    FILE* out = fopen(out_path, "w");
+    if (!out) return -1;
+    for (size_t i = 0; i < high_cps.size(); i++)
+        fprintf(out, "A %u %d\n", high_cps[i], (int32_t)(256 + i));
+
+    long target_merges = vocab_size - 256 - (long)high_cps.size();
+    long n_merges = 0;
+    int32_t next_id = (int32_t)(256 + high_cps.size());
+    std::vector<uint64_t> touched;
+    while (n_merges < target_merges && !heap.empty()) {
+        HeapEntry top = heap.top();
+        heap.pop();
+        auto it = pair_count.find(top.pair);
+        if (it == pair_count.end() || it->second != top.count) continue;  // stale
+        if (top.count < min_frequency) break;
+        int32_t a = (int32_t)(top.pair >> 32), b = (int32_t)(uint32_t)top.pair;
+        int32_t t = next_id++;
+        fprintf(out, "M %d %d %lld\n", a, b, (long long)top.count);
+        n_merges++;
+        pair_count.erase(it);
+
+        touched.clear();
+        auto occ_it = pair_words.find(top.pair);
+        if (occ_it != pair_words.end()) {
+            std::vector<int32_t> occ = std::move(occ_it->second);
+            pair_words.erase(occ_it);
+            for (int32_t w : occ) {
+                auto& s = syms[w];
+                // does this word still contain (a, b)?
+                bool has = false;
+                for (size_t i = 0; i + 1 < s.size(); i++)
+                    if (s[i] == a && s[i + 1] == b) { has = true; break; }
+                if (!has) continue;
+                int64_t wc = wcount[w];
+                // retire old adjacent-pair counts for the whole word
+                for (size_t i = 0; i + 1 < s.size(); i++) {
+                    uint64_t pr = pack(s[i], s[i + 1]);
+                    auto pit = pair_count.find(pr);
+                    if (pit != pair_count.end()) {
+                        pit->second -= wc;
+                        touched.push_back(pr);
+                    }
+                }
+                // rewrite the word with the merged symbol
+                std::vector<int32_t> ns;
+                ns.reserve(s.size());
+                for (size_t i = 0; i < s.size();) {
+                    if (i + 1 < s.size() && s[i] == a && s[i + 1] == b) {
+                        ns.push_back(t);
+                        i += 2;
+                    } else {
+                        ns.push_back(s[i]);
+                        i++;
+                    }
+                }
+                s = std::move(ns);
+                // add new adjacent-pair counts
+                for (size_t i = 0; i + 1 < s.size(); i++) {
+                    uint64_t pr = pack(s[i], s[i + 1]);
+                    pair_count[pr] += wc;
+                    touched.push_back(pr);
+                    auto& vec = pair_words[pr];
+                    if (vec.empty() || vec.back() != w) vec.push_back(w);
+                }
+            }
+        }
+        // re-queue every touched pair at its current count (lazy heap)
+        for (uint64_t pr : touched) {
+            auto pit = pair_count.find(pr);
+            if (pit != pair_count.end() && pit->second > 0)
+                heap.push({pit->second, pr});
+        }
+    }
+    fclose(out);
+    return n_merges;
+}
+
+}  // extern "C"

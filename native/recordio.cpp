@@ -123,4 +123,81 @@ long rio_find_feature(const unsigned char* buf, long len, const char* name,
     return -1;
 }
 
+// ---- writer: crc32c (Castagnoli) + framed record emission ---------------
+
+static uint32_t crc_table[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    if (crc_init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+        crc_table[0][i] = c;
+    }
+    // slice-by-8 tables
+    for (uint32_t i = 0; i < 256; i++)
+        for (int t = 1; t < 8; t++)
+            crc_table[t][i] = crc_table[0][crc_table[t - 1][i] & 0xFF]
+                              ^ (crc_table[t - 1][i] >> 8);
+    crc_init_done = true;
+}
+
+static uint32_t crc32c_raw(const unsigned char* buf, int64_t len) {
+    crc_init();
+    uint32_t crc = 0xFFFFFFFFu;
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, buf, 8);
+        word ^= crc;
+        crc = crc_table[7][word & 0xFF] ^ crc_table[6][(word >> 8) & 0xFF]
+            ^ crc_table[5][(word >> 16) & 0xFF] ^ crc_table[4][(word >> 24) & 0xFF]
+            ^ crc_table[3][(word >> 32) & 0xFF] ^ crc_table[2][(word >> 40) & 0xFF]
+            ^ crc_table[1][(word >> 48) & 0xFF] ^ crc_table[0][(word >> 56) & 0xFF];
+        buf += 8;
+        len -= 8;
+    }
+    while (len-- > 0)
+        crc = crc_table[0][(crc ^ *buf++) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+static uint32_t masked_crc32c(const unsigned char* buf, int64_t len) {
+    uint32_t crc = crc32c_raw(buf, len);
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8u);
+}
+
+// Masked crc32c of a buffer (TFRecord checksum).
+uint32_t rio_masked_crc(const unsigned char* buf, int64_t len) {
+    return masked_crc32c(buf, len);
+}
+
+// Append n framed records (payloads packed in `buf` at offsets/lengths) to
+// `path`.  TFRecord framing: u64 length | u32 masked-crc(length) | payload
+// | u32 masked-crc(payload).  Returns n, or -1 on open/write failure.
+long rio_write_records(const char* path, const unsigned char* buf,
+                       const int64_t* offsets, const int64_t* lengths,
+                       long n, int append) {
+    FILE* f = fopen(path, append ? "ab" : "wb");
+    if (!f) return -1;
+    for (long i = 0; i < n; i++) {
+        unsigned char header[12];
+        uint64_t len = (uint64_t)lengths[i];
+        memcpy(header, &len, 8);
+        uint32_t hcrc = masked_crc32c(header, 8);
+        memcpy(header + 8, &hcrc, 4);
+        const unsigned char* payload = buf + offsets[i];
+        uint32_t pcrc = masked_crc32c(payload, lengths[i]);
+        if (fwrite(header, 1, 12, f) != 12 ||
+            fwrite(payload, 1, (size_t)lengths[i], f) != (size_t)lengths[i] ||
+            fwrite(&pcrc, 1, 4, f) != 4) {
+            fclose(f);
+            return -1;
+        }
+    }
+    if (fclose(f) != 0) return -1;
+    return n;
+}
+
 }  // extern "C"

@@ -7,14 +7,20 @@ the same construction — unk token '\\x01', byte specials chr(0..255), and the
 "isolated" Split pre-tokenizer over the digits/whitespace/punctuation regex
 (train_tokenizer.pyx:180-188) — then writes ``tokenizer.json``.  The
 reference's surrounding Cython machinery streamed The Pile from the network;
-this trains from local text/jsonl files (zero-egress image), streamed through
-a multiprocess chunk-reader pool.
+this trains from local text/jsonl files (zero-egress image).
+
+Two backends:
+- ``native`` (default): the C++ trainer (native/bpe_trainer.cpp) — the
+  rebuild's equivalent of the reference's gcc-compiled Cython hot path —
+  byte-level merge training with multithreaded word counting.  jsonl inputs
+  are streamed to a raw-text spool first.
+- ``hf``: the HuggingFace ``tokenizers`` trainer fed through a multiprocess
+  chunk-reader pool.
 """
 import argparse
 import json
 import multiprocessing
 import os
-import string
 import sys
 
 
@@ -51,6 +57,37 @@ def _worker(paths, queue, chunk_bytes):
     queue.put(None)
 
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _native_main(args) -> bool:
+    from homebrewnlp_tpu.data import native_bpe
+    if not native_bpe.available():
+        return False
+    import tempfile
+    paths, spools = [], []
+    try:
+        for path in args.inputs:
+            if path.endswith(".jsonl"):
+                spool = tempfile.NamedTemporaryFile(
+                    mode="w", suffix=".txt", delete=False, errors="ignore")
+                spools.append(spool.name)
+                for chunk in _read_chunks(path, args.chunk_bytes):
+                    spool.write(chunk)
+                    spool.write("\n")
+                spool.close()
+                paths.append(spool.name)
+            else:
+                paths.append(path)
+        vocab = native_bpe.train_tokenizer_file(
+            paths, args.vocab_size, args.output, n_threads=args.processes)
+        print(f"wrote {args.output} (vocab {vocab}, native trainer)")
+        return True
+    finally:
+        for spool in spools:
+            os.unlink(spool)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("inputs", nargs="+", help="text or jsonl files")
@@ -58,17 +95,21 @@ def main():
     ap.add_argument("--output", default="tokenizer.json")
     ap.add_argument("--processes", type=int, default=4)
     ap.add_argument("--chunk-bytes", type=int, default=1 << 20)
+    ap.add_argument("--backend", choices=["native", "hf"], default="native")
     args = ap.parse_args()
+
+    if args.backend == "native":
+        if _native_main(args):
+            return
+        print("native trainer unavailable; falling back to hf", file=sys.stderr)
 
     from tokenizers import Regex, Tokenizer
     from tokenizers.models import BPE
     from tokenizers.pre_tokenizers import Split
     from tokenizers.trainers import BpeTrainer
+    from homebrewnlp_tpu.data import native_bpe
 
-    split_chars = string.digits + " \t\n\r\x0b\x0c"
-    for c in string.punctuation:
-        split_chars += "\\" + c
-    regex = Regex(f"[{split_chars}]|[^{split_chars}]+")
+    regex = Regex(native_bpe.split_regex())
     tokenizer = Tokenizer(BPE(unk_token="\x01"))
     tokenizer.pre_tokenizer = Split(regex, "isolated")
     trainer = BpeTrainer(special_tokens=[chr(i) for i in range(256)],

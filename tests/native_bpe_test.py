@@ -1,0 +1,178 @@
+"""Native C++ BPE trainer (native/bpe_trainer.cpp via data/native_bpe.py).
+
+Checks the merge algorithm against a tiny pure-python oracle and that the
+emitted tokenizer.json loads with the ``tokenizers`` library and round-trips
+text, matching the reference tokenizer construction
+(/root/reference/scripts/train_tokenizer.pyx:180-220).
+"""
+import collections
+import json
+import os
+import re
+import string
+import tempfile
+
+import pytest
+
+from homebrewnlp_tpu.data import native_bpe
+
+pytestmark = pytest.mark.skipif(not native_bpe.available(),
+                                reason="g++ toolchain unavailable")
+
+SPLIT = string.digits + " \t\n\r\x0b\x0c" + string.punctuation
+
+
+def _oracle_merges(text: bytes, n_merges: int):
+    """Reference BPE trainer: full pair recount each step."""
+    words = collections.Counter()
+    for run in re.split("[" + re.escape(SPLIT) + "]",
+                        text.decode("latin-1")):
+        if len(run) > 1:
+            words[tuple(ord(c) for c in run)] += 1
+    merges = []
+    next_id = 256
+    for _ in range(n_merges):
+        pairs = collections.Counter()
+        for word, count in words.items():
+            for a, b in zip(word, word[1:]):
+                pairs[(a, b)] += count
+        if not pairs:
+            break
+        best = max(pairs.items(), key=lambda kv: (kv[1], -kv[0][0] * (1 << 32) - kv[0][1]))
+        (a, b), count = best
+        if count < 1:
+            break
+        merges.append((a, b))
+        new_words = collections.Counter()
+        for word, cnt in words.items():
+            out = []
+            i = 0
+            while i < len(word):
+                if i + 1 < len(word) and word[i] == a and word[i + 1] == b:
+                    out.append(next_id)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            new_words[tuple(out)] += cnt
+        words = new_words
+        next_id += 1
+    return merges
+
+
+def _train(text: bytes, vocab_size: int):
+    with tempfile.NamedTemporaryFile(suffix=".txt", delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        return native_bpe.train_merges([path], vocab_size).merges
+    finally:
+        os.unlink(path)
+
+
+def native_matches_oracle_test():
+    text = (b"the cat sat on the mat. the cat ate the rat!\n" * 50
+            + b"lowering lowered lowest slower slowest\n" * 20)
+    merges = _train(text, 256 + 12)
+    oracle = _oracle_merges(text, 12)
+    # same multiset of merge counts is too weak; demand identical pairs where
+    # counts are distinct (ties may legally order differently)
+    assert merges[0] == oracle[0]
+    assert len(merges) == len(oracle)
+    assert set(merges) == set(oracle)
+
+
+def merge_counts_monotone_under_unique_counts_test():
+    # distinct pair frequencies -> fully deterministic order
+    text = b"aaab " * 97 + b"ccdd " * 31 + b"eeff " * 7
+    merges = _train(text, 256 + 3)
+    oracle = _oracle_merges(text, 3)
+    assert merges == oracle
+
+
+def isolated_split_prevents_cross_boundary_merges_test():
+    # digits/punct/whitespace are their own pre-tokens: no pair may span them
+    text = b"ab1ab,ab ab\nab" * 100
+    merges = _train(text, 256 + 8)
+    for a, b in merges:
+        for tok in (a, b):
+            if tok < 256:
+                assert chr(tok) not in SPLIT
+
+
+def unicode_alphabet_and_merges_test():
+    # non-ASCII codepoints join the alphabet with ids 256+ and participate in
+    # merges as codepoints (NOT utf-8 bytes), so encode-time text matches
+    tokenizers = pytest.importorskip("tokenizers")
+    text = ("café café café 世界世界 "
+            * 50).encode("utf-8")
+    with tempfile.NamedTemporaryFile(suffix=".txt", delete=False) as f:
+        f.write(text)
+        corpus = f.name
+    out = corpus + ".tokenizer.json"
+    try:
+        result = native_bpe.train_merges([corpus], 256 + 64)
+        cps = [cp for cp, _ in result.alphabet]
+        # é is U+00E9 < 256 (base alphabet); CJK chars join the discovered one
+        assert ord("é") not in cps
+        assert ord("世") in cps and ord("界") in cps
+        assert cps == sorted(cps)
+        native_bpe.train_tokenizer_file([corpus], 256 + 64, out)
+        tok = tokenizers.Tokenizer.from_file(out)
+        enc = tok.encode("café")
+        # "café" repeats 150x: must become a single learned token, and the
+        # unk token (id 1) must not appear
+        assert 1 not in enc.ids
+        assert len(enc.ids) == 1
+        assert tok.decode(enc.ids, skip_special_tokens=False) == "café"
+    finally:
+        os.unlink(corpus)
+        if os.path.exists(out):
+            os.unlink(out)
+
+
+def range_parallel_counting_matches_serial_test():
+    # >4MB corpus so the range splitter produces multiple 1MB+ chunks; the
+    # boundary-ownership rule must give bit-identical counts vs one thread
+    rng_words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+                 "longword" * 3, "x"]
+    import random
+    random.seed(0)
+    text = " ".join(random.choice(rng_words)
+                    for _ in range(700_000)).encode()
+    assert len(text) > 4 << 20
+    with tempfile.NamedTemporaryFile(suffix=".txt", delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        serial = native_bpe.train_merges([path], 256 + 10, n_threads=1)
+        parallel = native_bpe.train_merges([path], 256 + 10, n_threads=8)
+        assert serial == parallel
+    finally:
+        os.unlink(path)
+
+
+def tokenizer_json_loads_and_roundtrips_test():
+    tokenizers = pytest.importorskip("tokenizers")
+    text = b"hello world hello there hello hello world\n" * 40
+    with tempfile.NamedTemporaryFile(suffix=".txt", delete=False) as f:
+        f.write(text)
+        corpus = f.name
+    out = corpus + ".tokenizer.json"
+    try:
+        vocab = native_bpe.train_tokenizer_file([corpus], 256 + 20, out)
+        assert vocab > 256
+        with open(out) as fh:
+            doc = json.load(fh)
+        assert doc["model"]["type"] == "BPE"
+        tok = tokenizers.Tokenizer.from_file(out)
+        enc = tok.encode("hello world")
+        assert enc.ids, "no tokens produced"
+        # multi-char tokens must have been learned ("hello" repeats 160x)
+        assert len(enc.ids) < len("hello world")
+        assert "".join(tok.decode([i], skip_special_tokens=False)
+                       for i in enc.ids) == "hello world"
+    finally:
+        os.unlink(corpus)
+        if os.path.exists(out):
+            os.unlink(out)
