@@ -104,14 +104,17 @@ def main() -> int:
     t_compile = time.time()
     for _ in range(WARMUP_STEPS):
         state, metrics = trainer.step(state, make_batch())
-    jax.block_until_ready(metrics["loss"])
+    # sync by materialising the value: the axon tunnel's block_until_ready
+    # can return before the dispatched chain has executed, but producing the
+    # float forces the full step-dependency chain to completion
+    float(metrics["loss"])
     print(f"compile+warmup {time.time() - t_compile:.1f}s", file=sys.stderr)
 
     batches = [make_batch() for _ in range(MEASURE_STEPS)]
     t0 = time.time()
     for batch in batches:
         state, metrics = trainer.step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(metrics["loss"])  # value fetch = true device sync
     dt = time.time() - t0
 
     tokens = MEASURE_STEPS * params.train_batch_size * params.sequence_length
@@ -138,6 +141,7 @@ def main() -> int:
     except (OSError, ValueError):
         pass
 
+    print(f"final loss {final_loss:.4f}", file=sys.stderr)
     print(json.dumps({"metric": "LM tokens/sec/chip @ 32big_mixer",
                       "value": round(tokens_per_sec_chip, 2),
                       "unit": "tokens/sec/chip",

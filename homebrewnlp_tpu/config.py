@@ -178,7 +178,9 @@ class ModelParameter:
         self.layout_override: typing.Dict[str, str] = {}  # dim name -> mesh axis
         self.pipeline_stages = 1          # GPipe stages over the 'pipe' mesh axis
         self.pipeline_microbatches: typing.Optional[int] = None  # default = stages
-        self.scan_layers = False             # reserved (lax.scan over depth)
+        # lax.scan over depth: O(1) program size + bounded live activations
+        # (falls back to unrolled blocks when the stack isn't homogeneous)
+        self.scan_layers = True
         self.gradient_checkpointing_policy = "nothing_saveable"
 
         self.unknown_config_keys: typing.List[str] = []

@@ -61,6 +61,8 @@ def read_records(path: str) -> typing.Iterator[bytes]:
     data = buf.tobytes()
     for i in range(n):
         o, l = int(offsets[i]), int(lengths[i])
+        if o + l + 4 > size:  # truncated trailing record (crash mid-write)
+            return
         yield data[o:o + l]
 
 

@@ -184,7 +184,13 @@ class RecordWriter:
         self._pending: typing.List[bytes] = []
         self._pending_bytes = 0
         self._started = False
-        self._f = None if self._native else open(path, "wb")
+        if self._native:
+            # truncate eagerly so a crash before the first flush can't leave
+            # a previous run's complete file looking valid
+            open(path, "wb").close()
+            self._f = None
+        else:
+            self._f = open(path, "wb")
 
     def write(self, payload: bytes):
         if self._native:
@@ -236,11 +242,15 @@ def read_records(path: str, verify_crc: bool = False
         while True:
             header = f.read(12)
             if len(header) < 12:
+                if header and verify_crc:  # empty = clean EOF; partial = cut
+                    raise IOError(f"truncated record header in {path}")
                 return
             (length,) = struct.unpack("<Q", header[:8])
             payload = f.read(length)
             footer = f.read(4)
             if len(payload) < length:
+                if verify_crc:
+                    raise IOError(f"truncated record payload in {path}")
                 return
             if verify_crc:
                 (expect,) = struct.unpack("<I", header[8:12])

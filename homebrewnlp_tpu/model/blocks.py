@@ -26,6 +26,7 @@ import functools
 import typing
 
 import jax
+import jax.numpy as jnp
 
 from ..config import BlockConfig, ModelParameter
 from ..core import scope
@@ -56,7 +57,8 @@ class ReplayBlock:
     def __eq__(self, other):
         return isinstance(other, ReplayBlock) and self._key == other._key
 
-    def __call__(self, subset: Subset, x: NamedTensor) -> NamedTensor:
+    def __call__(self, subset: Subset, x: NamedTensor,
+                 it: typing.Optional[jax.Array] = None) -> NamedTensor:
         outer_rng = None
         outer_mesh = None
         outer_decode = None
@@ -67,8 +69,10 @@ class ReplayBlock:
         ctx = scope.Context("apply", params=subset, rng_key=None,
                             mesh=outer_mesh, decode=outer_decode)
         if outer_rng is not None:
+            # `it` is the (possibly traced) depth index under scan-over-layers
+            idx = self.depth_idx if it is None else it
             ctx.rng_key = jax.random.fold_in(outer_rng,
-                                             self.depth_idx * 131 + self.cfg_idx)
+                                             idx * 131 + self.cfg_idx)
         for seg in self.prefix:
             ctx.stack.append(scope._Frame(seg))
         # attention axis round-robin must replay identically
@@ -164,6 +168,227 @@ def _mom_bwd(fns, alpha, res, cot):
 momentum_sequence.defvjp(_mom_fwd, _mom_bwd)
 
 
+# ---- scan-over-layers (lax.scan over depth) ------------------------------
+#
+# The unrolled custom-vjp sequences above give XLA one giant program with
+# depth x block_config inlined blocks; the scheduler is then free to keep
+# dozens of per-block temporaries alive at once (observed: the 32big_mixer
+# backward wanted 18GB of HLO temps on a 16GB chip).  lax.scan bounds live
+# memory to ONE iteration's working set and makes program size O(1) in depth.
+# Per-depth parameters are stacked on a leading depth axis; `shared`
+# (cross-layer) weights stay unstacked and their gradients accumulate in the
+# scan carry.  Enabled by `scan_layers` (default on) whenever the stack is
+# depth-homogeneous; anything irregular falls back to the unrolled forms.
+
+def _iter_body(fns, shared, x1, x2, sl, it):
+    for f, stk, shr in zip(fns, sl, shared):
+        x1, x2 = x2, x1 + f({**stk, **shr}, x2, it=it)
+    return x1, x2
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def rev_scan(fns, stacked, shared, x1, x2):
+    def step(carry, sl):
+        x1, x2, it = carry
+        x1, x2 = _iter_body(fns, shared, x1, x2, sl, it)
+        return (x1, x2, it + 1), None
+
+    (x1, x2, _), _ = jax.lax.scan(step, (x1, x2, jnp.int32(0)), stacked)
+    return x1, x2
+
+
+def _rev_scan_fwd(fns, stacked, shared, x1, x2):
+    out = rev_scan(fns, stacked, shared, x1, x2)
+    return out, (stacked, shared, out)
+
+
+def _rev_scan_bwd(fns, res, cot):
+    stacked, shared, (a, b) = res
+    da, db = cot
+    depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    zero_shared = jax.tree_util.tree_map(jnp.zeros_like, shared)
+
+    def back(carry, sl):
+        a, b, da, db, dshared, it = carry
+        ds_out: typing.List[typing.Any] = [None] * len(fns)
+        dshared_new = list(dshared)
+        for c in range(len(fns) - 1, -1, -1):
+            f, stk, shr = fns[c], sl[c], shared[c]
+            b_prev = a
+            fval, fvjp = jax.vjp(
+                lambda stk_, shr_, x_: f({**stk_, **shr_}, x_, it=it),
+                stk, shr, b_prev)
+            a_prev = b - fval
+            dstk, dshr, db_extra = fvjp(db)
+            a, b = a_prev, b_prev
+            da, db = db, da + db_extra
+            ds_out[c] = dstk
+            dshared_new[c] = jax.tree_util.tree_map(lambda p, g: p + g,
+                                                    dshared_new[c], dshr)
+        return (a, b, da, db, tuple(dshared_new), it - 1), tuple(ds_out)
+
+    carry0 = (a, b, da, db, zero_shared, jnp.int32(depth - 1))
+    (_, _, da, db, dshared, _), ds_stacked = jax.lax.scan(
+        back, carry0, stacked, reverse=True)
+    return ds_stacked, dshared, da, db
+
+
+rev_scan.defvjp(_rev_scan_fwd, _rev_scan_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def momentum_scan(fns, alpha, stacked, shared, x, v):
+    def step(carry, sl):
+        x, v, it = carry
+        for f, stk, shr in zip(fns, sl, shared):
+            v = v * alpha + f({**stk, **shr}, x, it=it) * (1 - alpha)
+            x = x + v
+        return (x, v, it + 1), None
+
+    (x, v, _), _ = jax.lax.scan(step, (x, v, jnp.int32(0)), stacked)
+    return x, v
+
+
+def _mom_scan_fwd(fns, alpha, stacked, shared, x, v):
+    out = momentum_scan(fns, alpha, stacked, shared, x, v)
+    return out, (stacked, shared, out)
+
+
+def _mom_scan_bwd(fns, alpha, res, cot):
+    stacked, shared, (x, v) = res
+    dx, dv = cot
+    depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    zero_shared = jax.tree_util.tree_map(jnp.zeros_like, shared)
+
+    def back(carry, sl):
+        x, v, dx, dv, dshared, it = carry
+        ds_out: typing.List[typing.Any] = [None] * len(fns)
+        dshared_new = list(dshared)
+        for c in range(len(fns) - 1, -1, -1):
+            f, stk, shr = fns[c], sl[c], shared[c]
+            x_prev = x - v
+            fval, fvjp = jax.vjp(
+                lambda stk_, shr_, x_: f({**stk_, **shr_}, x_, it=it),
+                stk, shr, x_prev)
+            v_prev = (v - fval * (1 - alpha)) / alpha
+            g = dx + dv
+            dstk, dshr, dx_f = fvjp(g * (1 - alpha))
+            dx_prev = dx + dx_f
+            dv_prev = g * alpha
+            x, v = x_prev, v_prev
+            dx, dv = dx_prev, dv_prev
+            ds_out[c] = dstk
+            dshared_new[c] = jax.tree_util.tree_map(lambda p, q: p + q,
+                                                    dshared_new[c], dshr)
+        return (x, v, dx, dv, tuple(dshared_new), it - 1), tuple(ds_out)
+
+    carry0 = (x, v, dx, dv, zero_shared, jnp.int32(depth - 1))
+    (_, _, dx, dv, dshared, _), ds_stacked = jax.lax.scan(
+        back, carry0, stacked, reverse=True)
+    return ds_stacked, dshared, dx, dv
+
+
+momentum_scan.defvjp(_mom_scan_fwd, _mom_scan_bwd)
+
+
+def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool):
+    """Scanned 'checkpoint' / 'none' strategies: O(depth) carries saved by
+    scan AD; with use_checkpoint each block recomputes its interior."""
+    def step(carry, sl):
+        x, it = carry
+        for f, stk, shr in zip(fns, sl, shared):
+            if use_checkpoint:
+                x = jax.checkpoint(
+                    lambda sub, x_, it_, f_=f: f_(sub, x_, it=it_)
+                )({**stk, **shr}, x, it)
+            else:
+                x = f({**stk, **shr}, x, it=it)
+        return (x, it + 1), None
+
+    (x, _), _ = jax.lax.scan(step, (x, jnp.int32(0)), stacked)
+    return x
+
+
+def _plan_scan(params: ModelParameter,
+               plan: typing.Tuple[BlockSpec, ...]) -> typing.Optional[tuple]:
+    """Group the per-block parameter plan by cfg index for scanning.
+
+    Returns (rel_names, shared_names, abs_names) per cfg — rel names are the
+    depth-0 forms of per-depth parameters, abs_names[c][i] maps rel -> the
+    actual name at depth i — or None when the stack isn't depth-homogeneous."""
+    depth, n_cfg = params.depth, len(params.block_config)
+    if depth < 2:
+        return None
+    by = {(i, c): names for i, c, names in plan}
+    rel_per_cfg, shared_per_cfg, abs_per_cfg = [], [], []
+    for c in range(n_cfg):
+        marker1 = f"block1_{c}_"
+        names1 = by[(1, c)]
+        shared = tuple(n for n in names1 if marker1 not in n)
+        rel = tuple(n.replace(marker1, f"block0_{c}_")
+                    for n in names1 if marker1 in n)
+        abs_names = []
+        for i in range(depth):
+            marker = f"block{i}_{c}_"
+            names_i = by[(i, c)]
+            if not set(shared) <= set(names_i):
+                return None
+            perdepth = [n for n in names_i if n not in shared]
+            if any(marker not in n for n in perdepth):
+                return None
+            rel_i = {n.replace(marker, f"block0_{c}_"): n for n in perdepth}
+            if set(rel_i) != set(rel):
+                return None
+            abs_names.append(rel_i)
+        rel_per_cfg.append(rel)
+        shared_per_cfg.append(shared)
+        abs_per_cfg.append(abs_names)
+    return rel_per_cfg, shared_per_cfg, abs_per_cfg
+
+
+def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
+              strategy: str, attn_base: int) -> typing.Optional[NamedTensor]:
+    info = _plan_scan(params, plan)
+    if info is None:
+        return None
+    rel_per_cfg, shared_per_cfg, abs_per_cfg = info
+    if not any(rel_per_cfg):
+        # nothing to scan over (fully weight-tied / param-free stack):
+        # lax.scan would reject an empty xs pytree
+        return None
+    # attention-axis round-robin must look identical every iteration
+    from .utils import attention_axis_candidates
+    cycle = max(1, len(attention_axis_candidates(src.dims, params)))
+    attn_counts = [sum(layer.split("-")[0] == "attention" for layer in bc.layer)
+                   for bc in params.block_config]
+    if cycle > 1 and sum(attn_counts) % cycle:
+        return None
+    try:
+        stacked = tuple(
+            {r: jnp.stack([ctx.params[abs_per_cfg[c][i][r]]
+                           for i in range(params.depth)])
+             for r in rel_per_cfg[c]}
+            for c in range(len(params.block_config)))
+    except (ValueError, TypeError):  # ragged shapes across depth
+        return None
+    shared = tuple({n: ctx.params[n] for n in shared_per_cfg[c]}
+                   for c in range(len(params.block_config)))
+    prefix = tuple(f.name for f in ctx.stack[1:])
+    fns, off = [], 0
+    for c, bc in enumerate(params.block_config):
+        fns.append(ReplayBlock(params, bc, 0, c, prefix, attn_base + off))
+        off += attn_counts[c]
+    fns = tuple(fns)
+    if strategy == "revnet":
+        x1, x2 = rev_scan(fns, stacked, shared, src, src)
+        return x1 + x2
+    if strategy == "momentum":
+        x, v = momentum_scan(fns, params.momentumnet_alpha, stacked, shared,
+                             src, src)
+        return x + v
+    return _plain_scan(fns, stacked, shared, src, strategy == "checkpoint")
+
+
 # ---- body assembly -------------------------------------------------------
 
 def run_body_blocks(params: ModelParameter, src: NamedTensor,
@@ -199,7 +424,8 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
     prefix = tuple(f.name for f in ctx.stack[1:])
     fns = []
     subsets = []
-    attn_idx = params.attention_idx
+    attn_base = params.attention_idx
+    attn_idx = attn_base
     for (i, c, bc), (_, _, names) in zip(blocks, plan):
         fns.append(ReplayBlock(params, bc, i, c, prefix, attn_idx))
         attn_idx += sum(layer.split('-')[0] == "attention" for layer in bc.layer)
@@ -231,6 +457,13 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         from ..parallel.pipeline import pipeline_body
         return pipeline_body(params, mesh, fns, subsets, plan, src,
                              strategy), plan
+
+    if params.scan_layers:
+        # attention_idx was already advanced to its post-body value by the
+        # builder above; the scanned blocks replay from the captured base
+        scanned = _try_scan(params, ctx, plan, src, strategy, attn_base)
+        if scanned is not None:
+            return scanned, plan
 
     if strategy == "revnet":
         x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src)

@@ -97,13 +97,21 @@ def compare_range(params: ModelParameter, dim0: Dim, dim1: Dim,
                 params.calculation_dtype)
 
 
+def attention_axis_candidates(dims, params) -> list:
+    """Dims eligible for the attention round-robin: all non-feature dims
+    after batch (src/utils_mtf.py:418-422).  Single source of truth for
+    get_attention_dim, the scan-over-layers homogeneity gate, and the
+    pipeline scheduler."""
+    return [d for d in dims
+            if d not in params.feature_dims and d not in params.intermediate][1:]
+
+
 def get_attention_dim(args: BlockArgs) -> ATTENTION_DIM:
     """Round-robin choice of the mixing axis (src/utils_mtf.py:418-422):
     cycles over all non-feature dims after batch, enabling factorized
     multi-axis (time/height/width) attention for video."""
     params = args.params
-    attention_dims = [d for d in args.tensor.dims
-                      if d not in params.feature_dims and d not in params.intermediate][1:]
+    attention_dims = attention_axis_candidates(args.tensor.dims, params)
     idx = params.attention_idx % len(attention_dims)
     return ATTENTION_DIM(idx, attention_dims[idx])
 

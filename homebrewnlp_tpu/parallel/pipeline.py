@@ -103,8 +103,8 @@ def pipeline_body(params, mesh: Mesh, fns, subsets, plan, src: NamedTensor,
                          f"lower pipeline_microbatches or data parallelism")
 
     # attention round-robin must be stage-periodic (text models: cycle len 1)
-    feature = set(params.feature_dims) | set(params.intermediate)
-    n_mix_dims = max(1, len([d for d in src.dims if d not in feature][1:]))
+    from ..model.utils import attention_axis_candidates
+    n_mix_dims = max(1, len(attention_axis_candidates(src.dims, params)))
     attn_per_stage = sum(
         layer.split('-')[0] == 'attention'
         for i in range(params.depth // n_stages)

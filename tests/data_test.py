@@ -80,6 +80,26 @@ def native_crc_and_writer_parity_test(tmp_path):
         list(read_records(path, verify_crc=True))
 
 
+def truncated_file_detection_test(tmp_path):
+    payloads = [b"x" * 100, b"y" * 100]
+    path = str(tmp_path / "trunc_0_2.tfrecord")
+    with RecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+    full = os.path.getsize(path)
+    # cut inside the second payload: verify raises, non-verify yields 1 record
+    with open(path, "r+b") as f:
+        f.truncate(full - 54)
+    assert len(list(read_records(path))) == 1
+    with pytest.raises(IOError):
+        list(read_records(path, verify_crc=True))
+    # cut inside a header
+    with open(path, "r+b") as f:
+        f.truncate(116 + 5)
+    with pytest.raises(IOError):
+        list(read_records(path, verify_crc=True))
+
+
 def window_semantics_test(tmp_path):
     """window(size=ctx+patch, shift=ctx, drop_remainder) per record
     (reference inputs.py:247-249)."""
