@@ -181,6 +181,11 @@ class ModelParameter:
         # lax.scan over depth: O(1) program size + bounded live activations
         # (falls back to unrolled blocks when the stack isn't homogeneous)
         self.scan_layers = True
+        # pallas flash kernel for plain softmax dot-product attention
+        # (single-device; map-bias flags and decode use the dense path)
+        self.use_flash_attention = True
+        # lax.scan unroll factor for the depth scan (XLA overlap vs memory)
+        self.scan_unroll = 1
         self.gradient_checkpointing_policy = "nothing_saveable"
 
         self.unknown_config_keys: typing.List[str] = []

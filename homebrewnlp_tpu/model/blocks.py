@@ -186,23 +186,24 @@ def _iter_body(fns, shared, x1, x2, sl, it):
     return x1, x2
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def rev_scan(fns, stacked, shared, x1, x2):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def rev_scan(fns, unroll, stacked, shared, x1, x2):
     def step(carry, sl):
         x1, x2, it = carry
         x1, x2 = _iter_body(fns, shared, x1, x2, sl, it)
         return (x1, x2, it + 1), None
 
-    (x1, x2, _), _ = jax.lax.scan(step, (x1, x2, jnp.int32(0)), stacked)
+    (x1, x2, _), _ = jax.lax.scan(step, (x1, x2, jnp.int32(0)), stacked,
+                                  unroll=unroll)
     return x1, x2
 
 
-def _rev_scan_fwd(fns, stacked, shared, x1, x2):
-    out = rev_scan(fns, stacked, shared, x1, x2)
+def _rev_scan_fwd(fns, unroll, stacked, shared, x1, x2):
+    out = rev_scan(fns, unroll, stacked, shared, x1, x2)
     return out, (stacked, shared, out)
 
 
-def _rev_scan_bwd(fns, res, cot):
+def _rev_scan_bwd(fns, unroll, res, cot):
     stacked, shared, (a, b) = res
     da, db = cot
     depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
@@ -229,15 +230,15 @@ def _rev_scan_bwd(fns, res, cot):
 
     carry0 = (a, b, da, db, zero_shared, jnp.int32(depth - 1))
     (_, _, da, db, dshared, _), ds_stacked = jax.lax.scan(
-        back, carry0, stacked, reverse=True)
+        back, carry0, stacked, reverse=True, unroll=unroll)
     return ds_stacked, dshared, da, db
 
 
 rev_scan.defvjp(_rev_scan_fwd, _rev_scan_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def momentum_scan(fns, alpha, stacked, shared, x, v):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def momentum_scan(fns, alpha, unroll, stacked, shared, x, v):
     def step(carry, sl):
         x, v, it = carry
         for f, stk, shr in zip(fns, sl, shared):
@@ -245,16 +246,17 @@ def momentum_scan(fns, alpha, stacked, shared, x, v):
             x = x + v
         return (x, v, it + 1), None
 
-    (x, v, _), _ = jax.lax.scan(step, (x, v, jnp.int32(0)), stacked)
+    (x, v, _), _ = jax.lax.scan(step, (x, v, jnp.int32(0)), stacked,
+                                unroll=unroll)
     return x, v
 
 
-def _mom_scan_fwd(fns, alpha, stacked, shared, x, v):
-    out = momentum_scan(fns, alpha, stacked, shared, x, v)
+def _mom_scan_fwd(fns, alpha, unroll, stacked, shared, x, v):
+    out = momentum_scan(fns, alpha, unroll, stacked, shared, x, v)
     return out, (stacked, shared, out)
 
 
-def _mom_scan_bwd(fns, alpha, res, cot):
+def _mom_scan_bwd(fns, alpha, unroll, res, cot):
     stacked, shared, (x, v) = res
     dx, dv = cot
     depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
@@ -284,14 +286,15 @@ def _mom_scan_bwd(fns, alpha, res, cot):
 
     carry0 = (x, v, dx, dv, zero_shared, jnp.int32(depth - 1))
     (_, _, dx, dv, dshared, _), ds_stacked = jax.lax.scan(
-        back, carry0, stacked, reverse=True)
+        back, carry0, stacked, reverse=True, unroll=unroll)
     return ds_stacked, dshared, dx, dv
 
 
 momentum_scan.defvjp(_mom_scan_fwd, _mom_scan_bwd)
 
 
-def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool):
+def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool,
+                unroll: int = 1):
     """Scanned 'checkpoint' / 'none' strategies: O(depth) carries saved by
     scan AD; with use_checkpoint each block recomputes its interior."""
     def step(carry, sl):
@@ -305,7 +308,7 @@ def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool):
                 x = f({**stk, **shr}, x, it=it)
         return (x, it + 1), None
 
-    (x, _), _ = jax.lax.scan(step, (x, jnp.int32(0)), stacked)
+    (x, _), _ = jax.lax.scan(step, (x, jnp.int32(0)), stacked, unroll=unroll)
     return x
 
 
@@ -380,13 +383,14 @@ def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
         off += attn_counts[c]
     fns = tuple(fns)
     if strategy == "revnet":
-        x1, x2 = rev_scan(fns, stacked, shared, src, src)
+        x1, x2 = rev_scan(fns, params.scan_unroll, stacked, shared, src, src)
         return x1 + x2
     if strategy == "momentum":
-        x, v = momentum_scan(fns, params.momentumnet_alpha, stacked, shared,
-                             src, src)
+        x, v = momentum_scan(fns, params.momentumnet_alpha, params.scan_unroll,
+                             stacked, shared, src, src)
         return x + v
-    return _plain_scan(fns, stacked, shared, src, strategy == "checkpoint")
+    return _plain_scan(fns, stacked, shared, src, strategy == "checkpoint",
+                       params.scan_unroll)
 
 
 # ---- body assembly -------------------------------------------------------

@@ -37,38 +37,29 @@ def _anonymize_kv(x: NamedTensor, dim: Dim) -> NamedTensor:
     return anonymize(x, dim)
 
 
-def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
-                          key: typing.Union[NamedTensor, int],
-                          base: BlockArgs) -> typing.Optional[NamedTensor]:
-    """Route dot-product attention over a sequence-sharded mesh through ring
-    attention (parallel/ring_attention.py).  Only for plain softmax attention
-    on the 'sequence' dim — map-bias flags need the dense [s, s] map.  The
-    parameter-creation order matches the dense path so init (meshless) and
-    sharded apply resolve identical names."""
-    from ..core import scope as scope_mod
-    from ..core.tensor import nt, transpose_to
-    ctx = scope_mod.current()
-    mesh = ctx.mesh
+def _plain_softmax_qkv(args: BlockArgs, dim: Dim, qry: NamedTensor,
+                       key: typing.Union[NamedTensor, int], base: BlockArgs):
+    """Shared gate + extraction for the ring/flash kernel routes.
+
+    Returns (q, k, v, canonical, shp) — arrays reshaped to
+    [lead-dims-folded, dim, heads, features] — or None when only the dense
+    einsum reproduces the reference semantics: map-bias flags need the dense
+    [s, s] map, and shared_key_value leaves the value on the QUERY dim so the
+    reference contraction degenerates to val*rowsum(p) (spatial.py:60-66).
+    The parameter-creation order (key, qry, val) matches the dense path so
+    init (meshless) and kernel-routed apply resolve identical names."""
+    from ..core.tensor import transpose_to
     params = args.params
-    if ctx.decode is not None:
-        return None
-    if (mesh is None or "sequence" not in getattr(mesh, "axis_names", ())
-            or mesh.shape["sequence"] <= 1 or dim.name != "sequence"):
-        return None
     if any(f in args.name_extras for f in
-           ("biased_softmax", "biased_attention_map", "scale_attention_map")):
+           ("biased_softmax", "biased_attention_map", "scale_attention_map",
+            "shared_key_value")):
         return None
     if not isinstance(key, NamedTensor):
         return None
-    if "shared_key_value" in args.name_extras:
-        val = key
-    elif "input_as_value" in args.name_extras:
+    if "input_as_value" in args.name_extras:
         val = args.tensor
     else:
         val = activated_linear_out(base)
-    import jax.numpy as jnp
-    from ..parallel.ring_attention import ring_attention
-
     canonical = [d for d in args.tensor.dims
                  if d not in (dim, params.head_dim, params.key_dim)] \
         + [dim, params.head_dim, params.key_dim]
@@ -76,14 +67,68 @@ def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     # key may lack batch dims (positional embeds): broadcast via + 0*q
     k = transpose_to(key + 0 * qry, canonical)
     v = transpose_to(val + 0 * qry, canonical)
-    lead = canonical[:-3]
     bsz = 1
-    for d in lead:
+    for d in canonical[:-3]:
         bsz *= d.size
     shp = (bsz, dim.size, params.head_dim.size, params.key_dim.size)
-    out = ring_attention(q.data.reshape(shp), k.data.reshape(shp),
-                         v.data.reshape(shp), mesh, causal=is_masked(args),
+    return (q.data.reshape(shp), k.data.reshape(shp), v.data.reshape(shp),
+            canonical, shp)
+
+
+def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
+                          key: typing.Union[NamedTensor, int],
+                          base: BlockArgs) -> typing.Optional[NamedTensor]:
+    """Route dot-product attention over a sequence-sharded mesh through ring
+    attention (parallel/ring_attention.py); plain softmax attention on the
+    'sequence' dim only."""
+    from ..core import scope as scope_mod
+    from ..core.tensor import nt, transpose_to
+    ctx = scope_mod.current()
+    mesh = ctx.mesh
+    if ctx.decode is not None:
+        return None
+    if (mesh is None or "sequence" not in getattr(mesh, "axis_names", ())
+            or mesh.shape["sequence"] <= 1 or dim.name != "sequence"):
+        return None
+    qkv = _plain_softmax_qkv(args, dim, qry, key, base)
+    if qkv is None:
+        return None
+    q, k, v, canonical, _ = qkv
+    from ..parallel.ring_attention import ring_attention
+
+    # causal=True always: the dense softmax branch masks unconditionally
+    # (reference spatial.py:68), regardless of masked_attention_dimensions
+    out = ring_attention(q, k, v, mesh, causal=True,
                          scale=1.0)  # qry already carries the reference scale
+    out_nt = nt(out.reshape([d.size for d in canonical]), canonical)
+    return transpose_to(out_nt, args.tensor.dims)
+
+
+def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
+                           key: typing.Union[NamedTensor, int],
+                           base: BlockArgs) -> typing.Optional[NamedTensor]:
+    """Route plain softmax dot-product attention through the pallas flash
+    kernel (parallel/flash_attention.py): blockwise online softmax so the
+    [s, s] score matrix never hits HBM.  Single-device only for now — under
+    a mesh the kernel would need shard_map partitioning (ring attention
+    covers the sequence-sharded case; GSPMD covers the dense path).  Any
+    other spatial dims fold into the batch, so multi-axis (video) attention
+    uses it too.  Map-bias flags need the dense [s, s] map and fall through."""
+    from ..core import scope as scope_mod
+    from ..core.tensor import nt, transpose_to
+    ctx = scope_mod.current()
+    if ctx.decode is not None or ctx.mesh is not None:
+        return None
+    if not args.params.use_flash_attention:
+        return None
+    qkv = _plain_softmax_qkv(args, dim, qry, key, base)
+    if qkv is None:
+        return None
+    q, k, v, canonical, _ = qkv
+    from ..parallel.flash_attention import attention as flash
+
+    # causal=True always: the dense softmax branch masks unconditionally
+    out = flash(q, k, v, scale=1.0, causal=True)
     out_nt = nt(out.reshape([d.size for d in canonical]), canonical)
     return transpose_to(out_nt, args.tensor.dims)
 
@@ -140,6 +185,9 @@ def attention(args: BlockArgs) -> NamedTensor:
         ring_out = _maybe_ring_attention(args, dim, qry, key, base)
         if ring_out is not None:
             return ring_out
+        flash_out = _maybe_flash_attention(args, dim, qry, key, base)
+        if flash_out is not None:
+            return flash_out
         logit_shape = shape_sub(shape, shape_sub(linear_shapes(args).old,
                                                  [params.head_dim])) + [tmp]
         logit = einsum([qry, _anonymize_kv(key, dim)], output_shape=logit_shape)

@@ -1,0 +1,73 @@
+"""Flash-attention routing in the model's dot-product path (spatial.py).
+
+The flash route must match the dense softmax path numerically (same loss,
+same updated params after a step) and must not fire where the dense map is
+semantically required (bias flags, decode, meshes).
+"""
+import numpy as np
+import pytest
+
+from backend import make_params  # noqa: F401
+from homebrewnlp_tpu.config import ModelParameter
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer
+
+
+def _cfg(flash, flags="dot_product-context", **over):
+    cfg = {
+        "model_mode": "gpt", "use_video": False, "use_language": True,
+        "sequence_length": 128, "features_per_head": 16, "heads": 4,
+        "depth": 2, "train_batch_size": 4, "vocab_size": 64,
+        "memory_reduction_strategy": "none",
+        "block_config": [{"layer": ["norm-shift-scale-features-group",
+                                    f"attention-{flags}"]}],
+        "optimizer": "sm3-learning_rate",
+        "learning_rate": 0.01, "weight_decay": 0.0,
+        "calculation_dtype": "float32", "storage_dtype": "float32",
+        "slice_dtype": "float32", "use_flash_attention": flash,
+        "model_path": "/tmp/flash_route_test",
+    }
+    cfg.update(over)
+    return ModelParameter(cfg)
+
+
+def _step(flash, flags="dot_product-context", **over):
+    import jax
+    params = _cfg(flash, flags, **over)
+    model = Model(params)
+    trainer = Trainer(params, model)
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    batch = {"token_x": jnp.asarray(x),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+    state = trainer.init_state(batch)
+    state, metrics = trainer.step(state, batch, rng=jax.random.PRNGKey(3))
+    return state, metrics
+
+
+@pytest.mark.parametrize("flags", ["dot_product-context",
+                                   "dot_product-positional-absolute",
+                                   "dot_product-embedded-absolute-shared_key_value",
+                                   "dot_product-context-input_as_value"])
+def flash_route_matches_dense_test(flags):
+    state_d, metrics_d = _step(False, flags)
+    state_f, metrics_f = _step(True, flags)
+    np.testing.assert_allclose(float(metrics_f["loss"]),
+                               float(metrics_d["loss"]), rtol=1e-5)
+    for name in state_d.variables:
+        np.testing.assert_allclose(
+            np.asarray(state_f.variables[name]),
+            np.asarray(state_d.variables[name]), rtol=1e-4, atol=1e-6,
+            err_msg=f"{flags}: {name}")
+
+
+def flash_skips_biased_map_test():
+    # bias-map attention needs the dense [s, s] map; both settings must agree
+    # because the flash route declines these flags
+    flags = "dot_product-context-biased_softmax-absolute"
+    state_d, metrics_d = _step(False, flags)
+    state_f, metrics_f = _step(True, flags)
+    np.testing.assert_allclose(float(metrics_f["loss"]),
+                               float(metrics_d["loss"]), rtol=1e-6)
