@@ -131,3 +131,75 @@ def debug_similarity(interface: InterfaceWrapper, n: typing.Optional[int] = None
     score = matches / max(1, len(outs) - 1)
     print(f"debug similarity: {score:.3f} ({matches}/{len(outs) - 1} identical)")
     return score
+
+
+def unpatchify(frames, params):
+    """Invert the input pipeline's patchify transpose (data/video.py:60:
+    memory order [ps, ps, hp, wp, c] regardless of the three_axes view):
+    [seq, ...] -> [seq, frame_height, frame_width, c]."""
+    import numpy as np
+    frames = np.asarray(frames)
+    seq = frames.shape[0]
+    hp, wp, ps = (params.frame_height_patch, params.frame_width_patch,
+                  params.patch_size)
+    c = params.color_channels
+    return (frames.reshape(seq, ps, ps, hp, wp, c)
+            .transpose(0, 3, 1, 4, 2, 5)
+            .reshape(seq, params.frame_height, params.frame_width, c))
+
+
+def render_video(frames01, texts, params, path: str, upscale: int = 4,
+                 fps: int = 1, line_split: int = 2):
+    """Write sampled frames to an MJPG .avi with token-text overlay
+    (reference interface.py:13-58 semantics, numpy nearest-neighbour
+    upscaling instead of scipy).  ``frames01``: float [seq, ...] in the
+    input pipeline's patchified layout (data/video.py:60: memory order
+    [ps, ps, hp, wp, c]), values in [0, 1]; ``texts``: per-frame strings or
+    None.  Falls back to an .npz dump without cv2 / for bit-folded frames."""
+    import numpy as np
+    import os
+    frames01 = np.asarray(frames01)
+    h, w = params.frame_height, params.frame_width
+    c = params.color_channels
+    seq = frames01.shape[0]
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def _dump():
+        np.savez(path + ".npz", frames=frames01,
+                 texts=np.asarray(texts if texts is not None else []))
+        return path + ".npz"
+
+    if params.use_bit_fold_input_pipeline or c != 3:
+        return _dump()  # packed ints / non-BGR channel counts
+    try:
+        frames = unpatchify(frames01, params)
+    except ValueError:
+        return _dump()
+    try:
+        import cv2
+    except ImportError:
+        return _dump()
+    out_path = path if path.endswith(".avi") else path + ".avi"
+    writer = cv2.VideoWriter(out_path, cv2.VideoWriter_fourcc(*"MJPG"), fps,
+                             (w * upscale, h * upscale))
+    if not writer.isOpened():
+        return _dump()
+    for idx in range(seq):
+        img = np.uint8(np.clip(frames[idx], 0, 1) * 255)
+        img = img.repeat(upscale, axis=0).repeat(upscale, axis=1)
+        img = cv2.cvtColor(img, cv2.COLOR_RGB2BGR)
+        if texts is not None and idx < len(texts) and texts[idx]:
+            text = texts[idx]
+            step = max(1, len(text) // line_split)
+            for i in range(0, len(text), step):
+                cv2.putText(img, text[i:i + step],
+                            (10, 20 + 24 * (i // step)),
+                            cv2.FONT_HERSHEY_SIMPLEX, 0.5, (255, 0, 255), 1)
+        if params.use_autoregressive_sampling:
+            label = ("prompt" if idx < params.initial_autoregressive_position
+                     else "sample")
+            cv2.putText(img, label, (10, h * upscale - 10),
+                        cv2.FONT_HERSHEY_SIMPLEX, 0.5, (0, 128, 255), 1)
+        writer.write(img)
+    writer.release()
+    return out_path

@@ -167,3 +167,46 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
              jnp.asarray(end_iterations, jnp.int32),
              jax.random.PRNGKey(seed))
     return np.asarray(out)
+
+
+def sample_video(model: Model, variables, batch, initial_pos=None,
+                 steps: typing.Optional[int] = None):
+    """Autoregressive video continuation (reference inference.py:25-73).
+
+    Host-side frame loop: each step runs the full forward, writes the
+    predicted next frame (sigmoid output, rescaled to input units) into the
+    frame input at the current position, and — in language mode — the argmax
+    tokens into ``token_x`` at that position.  Returns (frames01, tokens):
+    frames01 float [batch, seq+1, ...] in [0, 1], tokens int or None.
+    """
+    import numpy as np
+    params = model.params
+    if initial_pos is None:
+        initial_pos = params.initial_autoregressive_position
+    seq = params.time_patch_size
+    end = seq if steps is None else min(seq, initial_pos + steps)
+
+    def _fwd(v, b):
+        info = model.apply(v, b)
+        return (info.frame_out.data,
+                info.token_out.data if params.use_language else jnp.zeros(()))
+
+    fwd = jax.jit(_fwd)
+
+    batch = dict(batch)
+    frame = np.asarray(batch["frame"]).astype(np.float32)
+    token_x = (np.asarray(batch["token_x"]) if params.use_language else None)
+    for pos in range(max(1, initial_pos), end):
+        out_frame, out_token = fwd(variables, {**batch,
+                                               "frame": jnp.asarray(frame),
+                                               **({"token_x": jnp.asarray(token_x)}
+                                                  if token_x is not None else {})})
+        # frame_out[:, t] / token_out[:, t] predict position t+1 (src/tgt
+        # shift: data tgt = frames[1:], token_y = tokens[1:])
+        pred = np.asarray(out_frame)[:, pos - 1]
+        frame[:, pos] = pred * 255.0
+        if token_x is not None:
+            tok = np.argmax(np.asarray(out_token), axis=-1)       # [b, s, ...]
+            token_x = token_x.copy()
+            token_x[:, pos] = tok[:, pos - 1].reshape(token_x[:, pos].shape)
+    return frame / 255.0, token_x

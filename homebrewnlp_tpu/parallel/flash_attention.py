@@ -4,10 +4,13 @@ The dot-product attention path's hot op for long context: computes
 softmax(q·kᵀ)·v blockwise in VMEM with an online softmax so the [seq, seq]
 score matrix never reaches HBM.  Complements parallel/ring_attention.py
 (which shards sequence *across* chips); this kernel is the within-chip
-blockwise pass.  Grid: (batch·heads, q blocks); each program streams k/v
-blocks up to the causal frontier.  Backward recomputes blockwise under a
-``jax.custom_vjp`` (flash-attention-2 style) so training works without the
-O(s²) residual.
+blockwise pass.  Grid: (batch·heads, q blocks, k blocks) with the
+online-softmax state (m, l, acc) carried in VMEM scratch across the
+innermost k dimension, so VMEM use is O(block) regardless of sequence
+length; causal blocks above the diagonal are skipped via a pl.when
+predicate.  Backward is a flash-2-style chunked XLA pass under
+``jax.custom_vjp`` — a lax.scan over q-row blocks recomputing softmax rows —
+so training needs neither the O(s²) residual nor an O(s²) recompute buffer.
 
 Falls back transparently to a fused XLA implementation on CPU or when pallas
 lowering is unavailable (tests run the kernel in interpret mode).
@@ -35,69 +38,83 @@ def _xla_reference(q, k, v, scale, causal):
     return out.astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq: int, scale: float, causal: bool):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, num_k: int, scale: float,
+                  causal: bool):
+    """3-D grid (batch*heads, q blocks, k blocks): one K/V block resident in
+    VMEM at a time, online-softmax state carried in VMEM scratch across the
+    innermost k dimension — VMEM use is O(block) regardless of sequence
+    length (a whole-K/V-resident variant OOMs scoped vmem at 16k)."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale          # [block_q, d]
-    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    ki = pl.program_id(2)
 
-    num_k = seq // block_k
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k_blk = k_ref[pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(ki * block_k, block_k), :].astype(jnp.float32)
+    # causal: blocks strictly above the diagonal contribute nothing
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal \
+        else (ki < num_k)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale      # [block_q, d]
+        k_blk = k_ref[...].astype(jnp.float32)          # [block_k, d]
+        v_blk = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(-1))
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(-1))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + p.sum(-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        l_ref[...] = l_ref[...] * alpha + p.sum(-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_ref[...] = m_new
 
-    if causal:
-        # only stream k blocks up to (and including) the diagonal
-        upper = (qi + 1) * block_q // block_k
-        upper = jnp.minimum(upper + (block_q % block_k != 0), num_k)
-    else:
-        upper = num_k
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...]
+                      / jnp.maximum(l_ref[...], 1e-30)[:, None]).astype(o_ref.dtype)
 
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
+    num_k = s // block_k
     # [b, s, h, d] -> [b*h, s, d]
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
-                               seq=s, scale=scale, causal=causal)
+                               num_k=num_k, scale=scale, causal=causal)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, s // block_q),
-        in_specs=[pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-                  pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-                  pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0))],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        grid=(b * h, s // block_q, num_k),
+        in_specs=[pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
+                  pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
+                  pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0))],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
+                        pltpu.VMEM((block_q,), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -117,13 +134,46 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, dout):
-    # blockwise recompute via XLA (flash-2-style pallas backward is a
-    # follow-up optimisation; this keeps memory O(s·d) by checkpointing)
+    """Flash-2-style chunked backward in XLA: lax.scan over q-row blocks
+    recomputing softmax rows per block, so peak memory is O(block_q·s) per
+    head instead of the dense [s, s] score matrix (which OOMs HBM at 16k)."""
     q, k, v = res
-    def f(q, k, v):
-        return _xla_reference(q, k, v, scale, causal)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(dout)
+    b, s, h, d = q.shape
+    bq = min(block_q, s)
+    f32 = jnp.float32
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d).astype(f32)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d).astype(f32)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d).astype(f32)
+    dot = dout.transpose(0, 2, 1, 3).reshape(b * h, s, d).astype(f32)
+    k_pos = jnp.arange(s)[None, :]
+
+    def step(carry, i):
+        dk, dv = carry
+        qb = jax.lax.dynamic_slice_in_dim(qt, i * bq, bq, 1)
+        dob = jax.lax.dynamic_slice_in_dim(dot, i * bq, bq, 1)
+        scores = jnp.einsum("zqd,zkd->zqk", qb, kt) * scale
+        if causal:
+            q_pos = i * bq + jnp.arange(bq)[:, None]
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        p = jax.nn.softmax(scores, axis=-1)
+        ob = jnp.einsum("zqk,zkd->zqd", p, vt)
+        delta = jnp.sum(dob * ob, -1)
+        dp = jnp.einsum("zqd,zkd->zqk", dob, vt)
+        ds = p * (dp - delta[..., None]) * scale
+        dqb = jnp.einsum("zqk,zkd->zqd", ds, kt)
+        dk = dk + jnp.einsum("zqk,zqd->zkd", ds, qb)
+        dv = dv + jnp.einsum("zqk,zqd->zkd", p, dob)
+        return (dk, dv), dqb
+
+    zeros = jnp.zeros_like(kt)
+    (dk, dv), dqs = jax.lax.scan(step, (zeros, zeros), jnp.arange(s // bq))
+    dq = jnp.moveaxis(dqs, 0, 1).reshape(b * h, s, d)
+
+    def back(x):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return (back(dq).astype(q.dtype), back(dk).astype(k.dtype),
+            back(dv).astype(v.dtype))
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -17,12 +17,38 @@ from ..train import checkpoint as ckpt
 from .train_loop import train as train_loop
 
 
+def _dummy_batch(params: ModelParameter, batch_size: int = 1,
+                 rng: typing.Optional[np.random.Generator] = None):
+    """Zero/random batch with the mode's input structure (text or video)."""
+    p = params
+    if rng is None:
+        rng = np.random.default_rng(0)
+    if not p.use_video:
+        seq = p.sequence_length // p.token_patch_size
+        zeros = np.zeros((batch_size, seq, p.token_patch_size), np.int32)
+        return {"token_x": zeros, "token_y": zeros.copy()}
+    fshape = ((batch_size, p.time_patch_size + 1, p.frame_height_patch,
+               p.frame_width_patch, p.channel_color_size) if p.three_axes else
+              (batch_size, p.time_patch_size + 1,
+               p.frame_height_patch * p.frame_width_patch,
+               p.channel_color_size))
+    batch = {"frame": np.asarray(rng.integers(0, 255, fshape), np.int32)}
+    ones_t = np.ones((batch_size, p.time_patch_size), np.float32)
+    batch.update(vid_msk_src=ones_t, vid_msk_tgt=ones_t.copy(),
+                 cat_mask_x=ones_t.copy(), cat_mask_y=ones_t.copy())
+    if p.use_language:
+        tshape = (batch_size, p.time_patch_size, p.language_token_patch,
+                  p.token_patch_size)
+        toks = rng.integers(0, p.vocab_size, tshape).astype(np.int32)
+        batch.update(token_x=toks, token_y=toks.copy(),
+                     txt_msk=np.ones(tshape, np.float32))
+    return batch
+
+
 def _load_model(params: ModelParameter):
     params = ModelParameter(params, train=False, train_batch_size=1)
     model = Model(params)
-    seq = params.sequence_length // params.token_patch_size
-    batch = {"token_x": np.zeros((1, seq, params.token_patch_size), np.int32),
-             "token_y": np.zeros((1, seq, params.token_patch_size), np.int32)}
+    batch = _dummy_batch(params)
     variables = model.init(batch)
     restored = ckpt.restore(params.model_path)
     if restored:
@@ -42,6 +68,9 @@ def train_mode(params: ModelParameter, args):
 
 def sample_mode(params: ModelParameter, args):
     params, model, variables = _load_model(params)
+    if params.use_video:
+        _sample_video_mode(params, model, variables)
+        return
     interface = InterfaceWrapper(params, model, variables)
     tok = Tokenizer(params)
     rng = np.random.default_rng(0)
@@ -52,6 +81,26 @@ def sample_mode(params: ModelParameter, args):
                                         seed=i)
         print(f"--- sample {i} ---")
         print(tok.decode(out))
+
+
+def _sample_video_mode(params: ModelParameter, model, variables):
+    """Video (jannet) sampling: autoregressive frame continuation rendered
+    to .avi (reference interface.py:13-58 / inference.py:25-73)."""
+    import os
+    from ..infer.interface import render_video
+    from ..infer.sampler import sample_video
+    rng = np.random.default_rng(0)
+    tok = Tokenizer(params)
+    for i in range(params.num_of_sample):
+        batch = _dummy_batch(params, rng=rng)
+        frames01, tokens = sample_video(model, variables, batch)
+        texts = None
+        if tokens is not None:
+            texts = [tok.decode(tokens[0, t].reshape(-1))
+                     for t in range(tokens.shape[1])]
+        path = render_video(frames01[0], texts, params,
+                            os.path.join(params.model_path, f"sample_{i}"))
+        print(f"--- sample {i}: {path} ---")
 
 
 def query_mode(params: ModelParameter, args):
@@ -77,6 +126,7 @@ def debug_mode(params: ModelParameter, args):
 RUN_MODE_FNS: typing.Dict[str, typing.Callable] = {
     "train": train_mode,
     "sample": sample_mode,
+    "debug_old": sample_mode,  # reference alias (src/main.py:36)
     "query": query_mode,
     "web_api": web_api_mode,
     "debug": debug_mode,
