@@ -109,26 +109,46 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
                            base: BlockArgs) -> typing.Optional[NamedTensor]:
     """Route plain softmax dot-product attention through the pallas flash
     kernel (parallel/flash_attention.py): blockwise online softmax so the
-    [s, s] score matrix never hits HBM.  Single-device only for now — under
-    a mesh the kernel would need shard_map partitioning (ring attention
-    covers the sequence-sharded case; GSPMD covers the dense path).  Any
-    other spatial dims fold into the batch, so multi-axis (video) attention
-    uses it too.  Map-bias flags need the dense [s, s] map and fall through."""
+    [s, s] score matrix never hits HBM.  On a data x model mesh the kernel
+    runs per-device under shard_map (batch on 'data', heads on 'model';
+    sequence is unsharded so local causality is global causality); the
+    sequence- and pipe-sharded cases use ring attention / the dense path.
+    Any other spatial dims fold into the batch, so multi-axis (video)
+    attention uses it too.  Map-bias flags need the dense [s, s] map and
+    fall through."""
     from ..core import scope as scope_mod
     from ..core.tensor import nt, transpose_to
     ctx = scope_mod.current()
-    if ctx.decode is not None or ctx.mesh is not None:
+    mesh = ctx.mesh
+    if ctx.decode is not None:
         return None
     if not args.params.use_flash_attention:
+        return None
+    if mesh is not None and (mesh.shape.get("sequence", 1) > 1
+                             or mesh.shape.get("pipe", 1) > 1):
         return None
     qkv = _plain_softmax_qkv(args, dim, qry, key, base)
     if qkv is None:
         return None
-    q, k, v, canonical, _ = qkv
+    q, k, v, canonical, shp = qkv
     from ..parallel.flash_attention import attention as flash
 
-    # causal=True always: the dense softmax branch masks unconditionally
-    out = flash(q, k, v, scale=1.0, causal=True)
+    if mesh is None:
+        # causal=True always: the dense softmax branch masks unconditionally
+        out = flash(q, k, v, scale=1.0, causal=True)
+    else:
+        import jax
+        from jax.sharding import PartitionSpec as P
+        data = mesh.shape.get("data", 1)
+        model = mesh.shape.get("model", 1)
+        if shp[0] % max(1, data) or shp[2] % max(1, model):
+            return None
+        spec = P("data" if "data" in mesh.axis_names else None, None,
+                 "model" if "model" in mesh.axis_names else None, None)
+        out = jax.shard_map(
+            lambda q_, k_, v_: flash(q_, k_, v_, scale=1.0, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
     out_nt = nt(out.reshape([d.size for d in canonical]), canonical)
     return transpose_to(out_nt, args.tensor.dims)
 

@@ -8,8 +8,17 @@ single 'text' feature (raw bytes, or int64 token ids with --tokens), named
 ``<prefix>_<index>_<tokencount>.tfrecord`` so the deterministic-resume
 simulation (homebrewnlp_tpu/data/inputs.py) can replay consumption from the
 filename convention.
+
+Inputs may be plain text, ``.jsonl`` (one {"text": ...} object per line),
+or Pile-style ``.jsonl.zst`` / ``.zst`` shards (the reference streamed The
+Pile's 30 zstd shards, text2tfrecord.py:35-107; this reads the same format
+from local files — zero-egress image).  Optional ``--gpt2-bpe`` encodes
+with a tokenizer.json (e.g. from scripts/train_tokenizer.py) into int64
+records instead of raw bytes.
 """
 import argparse
+import io
+import json
 import os
 import sys
 
@@ -18,9 +27,85 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example  # noqa: E402
 
 
+def _iter_text(path: str, chunk_bytes: int, text_mode: bool = False):
+    """Yield byte chunks from txt / jsonl / zstd-compressed jsonl files.
+
+    ``text_mode``: decode plain files through a text stream (incremental
+    UTF-8 decoding, so multi-byte chars never split at chunk boundaries) —
+    required when the chunks feed a tokenizer; raw-bytes datasets keep the
+    exact file bytes."""
+    if path.endswith(".zst"):
+        import zstandard
+        with open(path, "rb") as raw:
+            stream = zstandard.ZstdDecompressor(max_window_size=2 ** 31)\
+                .stream_reader(raw)
+            text = io.TextIOWrapper(stream, errors="ignore")
+            if ".jsonl" in path or _peek_jsonl(path):
+                yield from _iter_jsonl_lines(text, chunk_bytes)
+            else:
+                while True:
+                    chunk = text.read(chunk_bytes)
+                    if not chunk:
+                        return
+                    yield chunk.encode()
+    elif path.endswith(".jsonl"):
+        with open(path, errors="ignore") as f:
+            yield from _iter_jsonl_lines(f, chunk_bytes)
+    elif text_mode:
+        with open(path, errors="ignore") as f:
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    return
+                yield chunk.encode()
+    else:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    return
+                yield chunk
+
+
+def _peek_jsonl(path: str) -> bool:
+    """Pile shards are .jsonl.zst but sometimes named .zst only: treat as
+    jsonl only if the first line parses to an object with a 'text' field."""
+    import zstandard
+    with open(path, "rb") as raw:
+        stream = zstandard.ZstdDecompressor(max_window_size=2 ** 31)\
+            .stream_reader(raw)
+        head = io.TextIOWrapper(stream, errors="ignore").readline(1 << 20)
+    try:
+        doc = json.loads(head)
+    except json.JSONDecodeError:
+        return False
+    return isinstance(doc, dict) and "text" in doc
+
+
+def _iter_jsonl_lines(f, chunk_bytes: int):
+    # every document ends with "\n" so records never fuse across chunks
+    buf, size = [], 0
+    for line in f:
+        try:
+            doc = json.loads(line)
+            text = doc.get("text") if isinstance(doc, dict) else None
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(text, str) or not text:
+            continue
+        buf.append(text)
+        size += len(text)
+        if size >= chunk_bytes:
+            yield ("\n".join(buf) + "\n").encode()
+            buf, size = [], 0
+    if buf:
+        yield ("\n".join(buf) + "\n").encode()
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("inputs", nargs="+", help="input text files")
+    ap.add_argument("inputs", nargs="+",
+                    help="input text / jsonl / jsonl.zst files")
     ap.add_argument("--output-dir", required=True)
     ap.add_argument("--prefix", default="part")
     ap.add_argument("--chunk-tokens", type=int, default=2 ** 20,
@@ -29,7 +114,15 @@ def main():
     ap.add_argument("--tokens", action="store_true",
                     help="treat input as whitespace-separated int token ids "
                          "(writes int64 features, filenames tagged 'int64')")
+    ap.add_argument("--gpt2-bpe", metavar="TOKENIZER_JSON", default=None,
+                    help="encode text with this tokenizer.json into int64 "
+                         "records (reference text2tfrecord.py BPE mode)")
     args = ap.parse_args()
+
+    encoder = None
+    if args.gpt2_bpe:
+        from tokenizers import Tokenizer
+        encoder = Tokenizer.from_file(args.gpt2_bpe)
 
     os.makedirs(args.output_dir, exist_ok=True)
     file_idx = 0
@@ -40,13 +133,13 @@ def main():
         if not buffer:
             return
         total = sum(len(b) for b in buffer)
-        tag = "int64_" if args.tokens else ""
+        tag = "int64_" if (args.tokens or encoder) else ""
         name = f"{args.prefix}_{tag}{file_idx:05d}_{total}.tfrecord"
         with RecordWriter(os.path.join(args.output_dir, name)) as w:
             per_record = max(1, len(buffer) // args.records_per_file)
             for i in range(0, len(buffer), per_record):
                 group = buffer[i:i + per_record]
-                if args.tokens:
+                if args.tokens or encoder:
                     ids = [t for chunk in group for t in chunk]
                     w.write(encode_example({"text": ids}))
                 else:
@@ -68,16 +161,16 @@ def main():
                     flush()
                     pending = 0
         else:
-            with open(path, "rb") as f:
-                while True:
-                    chunk = f.read(args.chunk_tokens)
-                    if not chunk:
-                        break
-                    buffer.append(chunk)
-                    pending += len(chunk)
-                    if pending >= args.chunk_tokens:
-                        flush()
-                        pending = 0
+            for chunk in _iter_text(path, args.chunk_tokens,
+                                    text_mode=encoder is not None):
+                if encoder is not None:
+                    chunk = encoder.encode(
+                        chunk.decode(errors="ignore")).ids
+                buffer.append(chunk)
+                pending += len(chunk)
+                if pending >= args.chunk_tokens:
+                    flush()
+                    pending = 0
     flush()
 
 

@@ -1,8 +1,9 @@
 """Flash-attention routing in the model's dot-product path (spatial.py).
 
 The flash route must match the dense softmax path numerically (same loss,
-same updated params after a step) and must not fire where the dense map is
-semantically required (bias flags, decode, meshes).
+same updated params after a step) — including per-device under shard_map on
+data x model meshes — and must not fire where the dense map is semantically
+required (bias flags, decode, sequence-/pipe-sharded meshes).
 """
 import numpy as np
 import pytest
@@ -61,6 +62,29 @@ def flash_route_matches_dense_test(flags):
             np.asarray(state_f.variables[name]),
             np.asarray(state_d.variables[name]), rtol=1e-4, atol=1e-6,
             err_msg=f"{flags}: {name}")
+
+
+def flash_sharded_matches_unsharded_test():
+    # data x model mesh: the shard_map flash route (batch on 'data', heads on
+    # 'model') must match the unmeshed step exactly
+    import jax
+    from homebrewnlp_tpu.core import sharding as shardlib
+    params = _cfg(True, "dot_product-context", heads=4,
+                  mesh_shape_override={"data": 2, "model": 2}, tpu_size=4)
+    model = Model(params)
+    mesh = shardlib.build_mesh(params, jax.devices()[:4])
+    trainer = Trainer(params, model, mesh=mesh)
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    batch = {"token_x": jnp.asarray(x),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+    state = trainer.init_state(batch)
+    state, metrics = trainer.step(state, batch, rng=jax.random.PRNGKey(3))
+    state_u, metrics_u = _step(True, "dot_product-context", heads=4)
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(metrics_u["loss"]), rtol=1e-5)
 
 
 def flash_skips_biased_map_test():
