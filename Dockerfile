@@ -7,7 +7,7 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
         ffmpeg g++ make && \
     rm -rf /var/lib/apt/lists/*
 
-RUN pip install --no-cache-dir numpy opencv-python-headless tokenizers
+RUN pip install --no-cache-dir numpy opencv-python-headless tokenizers zstandard
 
 WORKDIR /workspace
 COPY homebrewnlp_tpu/ homebrewnlp_tpu/

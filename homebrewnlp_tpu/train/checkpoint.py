@@ -9,9 +9,10 @@ recovered from the checkpoint directory at startup exactly like the
 reference reads it from the checkpoint dir (src/main.py:71), and
 ``max_checkpoints_keep`` pruning matches src/dataclass.py:51.
 
-Arrays are fetched shard-by-shard via ``jax.device_get`` — on a multi-host
-pod each process saves only addressable shards (process index recorded in the
-manifest), tensorstore-style.
+The whole state tree is fetched in one batched ``jax.device_get`` (per-leaf
+fetches serialize on the device queue and pay a round trip each) and written
+one file per array — on a multi-host pod each process saves only addressable
+shards (process index recorded in the manifest), tensorstore-style.
 """
 from __future__ import annotations
 
@@ -89,11 +90,14 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
         "extra": extra or {},
     }
     tree = {"variables": variables, "opt_state": opt_state}
+    # one batched device->host transfer (per-leaf fetches serialize on the
+    # device queue and pay a round trip each — minutes for GB-scale state)
+    tree = jax.device_get(tree)
     for i, (key, value) in enumerate(_leaf_files(tree)):
-        host = np.asarray(jax.device_get(value))
+        host = np.asarray(value)
         fname = f"arr_{i:06d}.bin"
         with open(os.path.join(tmp_dir, fname), "wb") as f:
-            f.write(host.tobytes())
+            host.tofile(f)
         manifest["arrays"][key] = {"file": fname,
                                    "shape": list(host.shape),
                                    "dtype": _dtype_name(host.dtype)}

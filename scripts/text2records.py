@@ -39,15 +39,23 @@ def _iter_text(path: str, chunk_bytes: int, text_mode: bool = False):
         with open(path, "rb") as raw:
             stream = zstandard.ZstdDecompressor(max_window_size=2 ** 31)\
                 .stream_reader(raw)
-            text = io.TextIOWrapper(stream, errors="ignore")
             if ".jsonl" in path or _peek_jsonl(path):
-                yield from _iter_jsonl_lines(text, chunk_bytes)
-            else:
+                yield from _iter_jsonl_lines(
+                    io.TextIOWrapper(stream, errors="ignore"), chunk_bytes)
+            elif text_mode:
+                text = io.TextIOWrapper(stream, errors="ignore")
                 while True:
                     chunk = text.read(chunk_bytes)
                     if not chunk:
                         return
                     yield chunk.encode()
+            else:
+                # raw-bytes mode: exact decompressed bytes, no re-decode
+                while True:
+                    chunk = stream.read(chunk_bytes)
+                    if not chunk:
+                        return
+                    yield chunk
     elif path.endswith(".jsonl"):
         with open(path, errors="ignore") as f:
             yield from _iter_jsonl_lines(f, chunk_bytes)
@@ -69,17 +77,21 @@ def _iter_text(path: str, chunk_bytes: int, text_mode: bool = False):
 
 def _peek_jsonl(path: str) -> bool:
     """Pile shards are .jsonl.zst but sometimes named .zst only: treat as
-    jsonl only if the first line parses to an object with a 'text' field."""
+    jsonl if the first line parses to an object with a 'text' field, or is a
+    json-object prefix too long to finish within the peek window (huge first
+    documents are still json, never plain text starting with '{\"text\"')."""
     import zstandard
+    limit = 8 << 20
     with open(path, "rb") as raw:
         stream = zstandard.ZstdDecompressor(max_window_size=2 ** 31)\
             .stream_reader(raw)
-        head = io.TextIOWrapper(stream, errors="ignore").readline(1 << 20)
+        head = io.TextIOWrapper(stream, errors="ignore").readline(limit)
     try:
         doc = json.loads(head)
+        return isinstance(doc, dict) and "text" in doc
     except json.JSONDecodeError:
-        return False
-    return isinstance(doc, dict) and "text" in doc
+        return (len(head) >= limit and "\n" not in head
+                and head.lstrip()[:1] == "{")
 
 
 def _iter_jsonl_lines(f, chunk_bytes: int):

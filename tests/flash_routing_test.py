@@ -85,6 +85,12 @@ def flash_sharded_matches_unsharded_test():
     state_u, metrics_u = _step(True, "dot_product-context", heads=4)
     np.testing.assert_allclose(float(metrics["loss"]),
                                float(metrics_u["loss"]), rtol=1e-5)
+    # updated params validate the shard_map backward, not just the forward
+    for name in state_u.variables:
+        np.testing.assert_allclose(
+            np.asarray(state.variables[name]),
+            np.asarray(state_u.variables[name]), rtol=2e-4, atol=1e-6,
+            err_msg=name)
 
 
 def flash_skips_biased_map_test():
