@@ -173,8 +173,10 @@ def main():
                     flush()
                     pending = 0
         else:
+            n_chunks = 0
             for chunk in _iter_text(path, args.chunk_tokens,
                                     text_mode=encoder is not None):
+                n_chunks += 1
                 if encoder is not None:
                     chunk = encoder.encode(
                         chunk.decode(errors="ignore")).ids
@@ -183,6 +185,10 @@ def main():
                 if pending >= args.chunk_tokens:
                     flush()
                     pending = 0
+            if n_chunks == 0:
+                print(f"WARNING: {path} yielded no text — if it is not "
+                      f"jsonl, the jsonl sniffing may have misrouted it",
+                      file=sys.stderr)
     flush()
 
 
