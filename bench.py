@@ -86,29 +86,50 @@ def main() -> int:
         cfg.update(sequence_length=64, features_per_head=64, depth=4,
                    train_batch_size=8)
 
-    params = ModelParameter(cfg)
-    model = Model(params)
-    trainer = Trainer(params, model)
     rng = np.random.default_rng(0)
 
-    def make_batch():
-        x = rng.integers(0, params.vocab_size,
-                         (params.train_batch_size,
-                          params.sequence_length, 1))
-        return {"token_x": jnp.asarray(x),
-                "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+    def build(cfg):
+        params = ModelParameter(cfg)
+        model = Model(params)
+        trainer = Trainer(params, model)
 
-    state = trainer.init_state(make_batch())
-    print(f"setup {time.time() - t_setup:.1f}s; compiling...", file=sys.stderr)
+        def make_batch():
+            x = rng.integers(0, params.vocab_size,
+                             (params.train_batch_size,
+                              params.sequence_length, 1))
+            return {"token_x": jnp.asarray(x),
+                    "token_y": jnp.asarray((x + 1) % params.vocab_size)}
 
-    t_compile = time.time()
-    for _ in range(WARMUP_STEPS):
-        state, metrics = trainer.step(state, make_batch())
-    # sync by materialising the value: the axon tunnel's block_until_ready
-    # can return before the dispatched chain has executed, but producing the
-    # float forces the full step-dependency chain to completion
-    float(metrics["loss"])
-    print(f"compile+warmup {time.time() - t_compile:.1f}s", file=sys.stderr)
+        state = trainer.init_state(make_batch())
+        print(f"setup {time.time() - t_setup:.1f}s; compiling...",
+              file=sys.stderr)
+        t_compile = time.time()
+        for _ in range(WARMUP_STEPS):
+            state, metrics = trainer.step(state, make_batch())
+        # sync by materialising the value: the axon tunnel's
+        # block_until_ready can return before the dispatched chain has
+        # executed; producing the float forces the chain to completion
+        float(metrics["loss"])
+        print(f"compile+warmup {time.time() - t_compile:.1f}s",
+              file=sys.stderr)
+        return params, trainer, state, make_batch
+
+    retry = False
+    try:
+        params, trainer, state, make_batch = build(cfg)
+    except Exception as exc:  # insurance: halve the batch once on OOM
+        if "memory" not in str(exc).lower() and "RESOURCE" not in str(exc):
+            raise
+        print(f"OOM at batch {cfg['train_batch_size']}; retrying at half",
+              file=sys.stderr)
+        retry = True
+    if retry:
+        # retry outside the handler so the failed attempt's frames (and the
+        # device buffers they pin) are released first
+        import gc
+        gc.collect()
+        cfg["train_batch_size"] //= 2
+        params, trainer, state, make_batch = build(cfg)
 
     batches = [make_batch() for _ in range(MEASURE_STEPS)]
     t0 = time.time()
@@ -122,19 +143,22 @@ def main() -> int:
     tokens_per_sec_chip = tokens / dt / n_chips
 
     # first recorded value per backend becomes the baseline; later runs
-    # report progress against it
+    # report progress against it (batch size is part of the config identity
+    # so an OOM-halved run never corrupts the full-batch baseline)
     vs_baseline = 1.0
     backend = jax.default_backend()
+    config_id = f"32big_mixer/1chip/b{params.train_batch_size}"
     baselines = {}
     try:
         if os.path.exists(BASELINE_FILE):
             with open(BASELINE_FILE) as f:
                 baselines = json.load(f)
-        if backend in baselines and baselines[backend].get("value"):
-            vs_baseline = tokens_per_sec_chip / float(baselines[backend]["value"])
-        else:
+        prior = baselines.get(backend, {})
+        if prior.get("value") and prior.get("config", config_id) == config_id:
+            vs_baseline = tokens_per_sec_chip / float(prior["value"])
+        elif prior.get("config", config_id) == config_id:
             baselines[backend] = {"value": tokens_per_sec_chip,
-                                  "config": "32big_mixer/1chip",
+                                  "config": config_id,
                                   "time": time.time()}
             with open(BASELINE_FILE, "w") as f:
                 json.dump(baselines, f)

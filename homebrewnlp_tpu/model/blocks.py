@@ -405,6 +405,28 @@ def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
                        params.scan_unroll)
 
 
+def _forward_recurrence(strategy: str, alpha: float, pairs, carry,
+                        it=None):
+    """One shared forward-only walk of the block recurrences (decode and the
+    decode-scan body both use it): revnet/momentum carry two streams, the
+    rest one.  ``pairs`` yields (fn, subset)."""
+    if strategy == "revnet":
+        x1, x2 = carry
+        for f, subset in pairs:
+            x1, x2 = x2, x1 + f(subset, x2, it=it)
+        return x1, x2
+    if strategy == "momentum":
+        x, v = carry
+        for f, subset in pairs:
+            v = v * alpha + f(subset, x, it=it) * (1 - alpha)
+            x = x + v
+        return x, v
+    (x,) = carry
+    for f, subset in pairs:
+        x = f(subset, x, it=it)
+    return (x,)
+
+
 def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
                      strategy: str, attn_base: int
                      ) -> typing.Optional[NamedTensor]:
@@ -464,24 +486,12 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
         saved_decode = ctx.decode
         ctx.decode = sub
         try:
-            if strategy == "revnet":
-                x1, x2, it = carry
-                for c, f in enumerate(fns):
-                    x1, x2 = x2, x1 + f({**sl_params[c], **shared[c]}, x2,
-                                        it=it)
-                new_carry = (x1, x2, it + 1)
-            elif strategy == "momentum":
-                x, v, it = carry
-                for c, f in enumerate(fns):
-                    v = v * alpha + f({**sl_params[c], **shared[c]}, x,
-                                      it=it) * (1 - alpha)
-                    x = x + v
-                new_carry = (x, v, it + 1)
-            else:
-                x, it = carry
-                for c, f in enumerate(fns):
-                    x = f({**sl_params[c], **shared[c]}, x, it=it)
-                new_carry = (x, it + 1)
+            *streams, it = carry
+            pairs = [(f, {**sl_params[c], **shared[c]})
+                     for c, f in enumerate(fns)]
+            streams = _forward_recurrence(strategy, alpha, pairs,
+                                          tuple(streams), it=it)
+            new_carry = (*streams, it + 1)
         finally:
             ctx.decode = saved_decode
         return new_carry, dict(sub.out)
@@ -495,13 +505,8 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
             continue  # cache born inside the scan: not part of the carry
         for i in range(params.depth):
             state.out[per_depth_caches[i][rel]] = arr[i]
-    if strategy == "revnet":
-        x1, x2, _ = carry
-        return x1 + x2
-    if strategy == "momentum":
-        x, v, _ = carry
-        return x + v
-    return carry[0]
+    *streams, _ = carry
+    return sum(streams[1:], streams[0])
 
 
 # ---- body assembly -------------------------------------------------------
@@ -556,21 +561,11 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
                                        attn_base)
             if scanned is not None:
                 return scanned, plan
-        if strategy == "revnet":
-            x1 = x2 = src
-            for f, s in zip(fns, subsets):
-                x1, x2 = x2, x1 + f(s, x2)
-            return x1 + x2, plan
-        if strategy == "momentum":
-            x, v = src, src
-            for f, s in zip(fns, subsets):
-                v = v * params.momentumnet_alpha + f(s, x) * (1 - params.momentumnet_alpha)
-                x = x + v
-            return x + v, plan
-        out = src
-        for f, s in zip(fns, subsets):
-            out = f(s, out)
-        return out, plan
+        carry = ((src, src) if strategy in ("revnet", "momentum")
+                 else (src,))
+        streams = _forward_recurrence(strategy, params.momentumnet_alpha,
+                                      zip(fns, subsets), carry)
+        return sum(streams[1:], streams[0]), plan
 
     mesh = ctx.mesh
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:
