@@ -95,6 +95,34 @@ def scan_falls_back_on_paramless_stack_test():
                                float(metrics_u["loss"]), rtol=1e-6)
 
 
+def decode_scan_engages_test(monkeypatch):
+    """The KV sampler's while_loop body must take the scanned decode path
+    (a silent fallback to the unrolled body is a 16x decode regression)."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.model import blocks
+    from homebrewnlp_tpu.infer import sampler
+    hits = {"scan": 0}
+    orig = blocks._try_decode_scan
+
+    def spy(*a, **k):
+        out = orig(*a, **k)
+        if out is not None:
+            hits["scan"] += 1
+        return out
+
+    monkeypatch.setattr(blocks, "_try_decode_scan", spy)
+    params = _cfg("revnet", scan=True, depth=3, train_batch_size=1)
+    model = Model(params)
+    variables = {k: jnp.asarray(v) for k, v in model.init(
+        {"token_x": np.zeros((1, params.sequence_length, 1), np.int32),
+         "token_y": np.zeros((1, params.sequence_length, 1), np.int32)}).items()}
+    out = sampler.sample_text(model, variables,
+                              np.asarray([[1, 2, 3]], np.int32),
+                              temperature=0.0, seed=0)
+    assert out.shape[1] == params.sequence_length
+    assert hits["scan"] >= 1, "decode scan never engaged"
+
+
 def scan_with_dropout_matches_test():
     # dropout draws from the per-depth folded rng; traced fold must replay
     # identically in the scanned backward recompute
