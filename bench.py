@@ -142,6 +142,18 @@ def main() -> int:
     n_chips = max(1, len(jax.devices()))
     tokens_per_sec_chip = tokens / dt / n_chips
 
+    # MFU: exact matmul FLOPs from the jaxpr, 3x-forward convention (no
+    # rematerialization credit — revnet's recompute is not "useful" FLOPs)
+    try:
+        from homebrewnlp_tpu.utils.flops import forward_flops, mfu
+        fwd_flops = forward_flops(
+            lambda v, b: trainer.model.apply(v, b).total_loss.data,
+            state.variables, batches[0])
+        mfu_frac = mfu(fwd_flops, dt / MEASURE_STEPS, n_chips)
+    except Exception as exc:
+        print(f"MFU computation failed: {exc}", file=sys.stderr)
+        mfu_frac = None
+
     # first recorded value per backend becomes the baseline; later runs
     # report progress against it (batch size is part of the config identity
     # so an OOM-halved run never corrupts the full-batch baseline)
@@ -166,10 +178,13 @@ def main() -> int:
         pass
 
     print(f"final loss {final_loss:.4f}", file=sys.stderr)
-    print(json.dumps({"metric": "LM tokens/sec/chip @ 32big_mixer",
-                      "value": round(tokens_per_sec_chip, 2),
-                      "unit": "tokens/sec/chip",
-                      "vs_baseline": round(vs_baseline, 4)}))
+    out = {"metric": "LM tokens/sec/chip @ 32big_mixer",
+           "value": round(tokens_per_sec_chip, 2),
+           "unit": "tokens/sec/chip",
+           "vs_baseline": round(vs_baseline, 4)}
+    if mfu_frac is not None:
+        out["mfu"] = round(mfu_frac, 4)
+    print(json.dumps(out))
     return 0
 
 

@@ -115,6 +115,11 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
         scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
+        # the innermost k dimension carries the online-softmax scratch state
+        # and MUST run sequentially ("arbitrary"); the outer two dims are
+        # independent and may be partitioned across megacore
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -9,10 +9,11 @@ recovered from the checkpoint directory at startup exactly like the
 reference reads it from the checkpoint dir (src/main.py:71), and
 ``max_checkpoints_keep`` pruning matches src/dataclass.py:51.
 
-The whole state tree is fetched in one batched ``jax.device_get`` (per-leaf
-fetches serialize on the device queue and pay a round trip each) and written
-one file per array — on a multi-host pod each process saves only addressable
-shards (process index recorded in the manifest), tensorstore-style.
+The state tree is fetched in ~1GB batched ``jax.device_get`` chunks (per-leaf
+fetches serialize on the device queue and pay a round trip each; one giant
+fetch would double peak host RAM) and written one file per array — on a
+multi-host pod each process saves only addressable shards (process index
+recorded in the manifest), tensorstore-style.
 """
 from __future__ import annotations
 
@@ -90,17 +91,30 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
         "extra": extra or {},
     }
     tree = {"variables": variables, "opt_state": opt_state}
-    # one batched device->host transfer (per-leaf fetches serialize on the
-    # device queue and pay a round trip each — minutes for GB-scale state)
-    tree = jax.device_get(tree)
-    for i, (key, value) in enumerate(_leaf_files(tree)):
-        host = np.asarray(value)
-        fname = f"arr_{i:06d}.bin"
-        with open(os.path.join(tmp_dir, fname), "wb") as f:
-            host.tofile(f)
-        manifest["arrays"][key] = {"file": fname,
-                                   "shape": list(host.shape),
-                                   "dtype": _dtype_name(host.dtype)}
+    # batched device->host transfers (per-leaf fetches serialize on the
+    # device queue and pay a round trip each — minutes for GB-scale state),
+    # chunked to ~1GB so the whole state never materializes on host at once
+    leaves = list(_leaf_files(tree))
+    chunk_budget = 1 << 30
+    i = 0
+    while i < len(leaves):
+        chunk = []
+        size = 0
+        while i < len(leaves) and (not chunk or size < chunk_budget):
+            key, value = leaves[i]
+            chunk.append((i, key, value))
+            size += getattr(value, "nbytes", 0) or int(
+                np.prod(getattr(value, "shape", (1,)))) * 4
+            i += 1
+        fetched = jax.device_get([v for _, _, v in chunk])
+        for (idx, key, _), value in zip(chunk, fetched):
+            host = np.asarray(value)
+            fname = f"arr_{idx:06d}.bin"
+            with open(os.path.join(tmp_dir, fname), "wb") as f:
+                host.tofile(f)
+            manifest["arrays"][key] = {"file": fname,
+                                       "shape": list(host.shape),
+                                       "dtype": _dtype_name(host.dtype)}
     with open(os.path.join(tmp_dir, "index.json"), "w") as f:
         json.dump(manifest, f)
     if os.path.exists(ckpt_dir):

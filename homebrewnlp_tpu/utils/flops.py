@@ -1,0 +1,118 @@
+"""Matmul FLOP counting + MFU.
+
+Model FLOPs are counted exactly by walking a jaxpr and summing
+``2 * M * N * K * batch`` over every ``dot_general`` (descending into scans
+with their trip counts, pjit/custom-vjp calls, etc.).  MFU follows the
+standard convention: useful model FLOPs = 3x the forward pass (forward +
+2x backward), NOT the executed FLOPs — rematerialization (revnet/checkpoint
+recompute) does not get credit.  The reference had no FLOP accounting at all
+(SURVEY.md §5.1: wall-clock phase prints only).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import numpy as np
+
+# bf16 peak TFLOP/s per chip by device kind (MXU); int8 peaks are 2x
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 138e12,   # v4i inference
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+    "cpu": 1e12,             # nominal, so CPU runs still print a number
+}
+
+
+def peak_flops(device: typing.Optional[jax.Device] = None) -> float:
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "cpu")
+    if kind in PEAK_TFLOPS:
+        return PEAK_TFLOPS[kind]
+    for name, peak in PEAK_TFLOPS.items():
+        if name.lower() in str(kind).lower():
+            return peak
+    return PEAK_TFLOPS["cpu"]
+
+
+def _dot_flops(eqn) -> int:
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    batch = int(np.prod([lhs.shape[i] for i in lb], dtype=np.int64)) if lb else 1
+    k = int(np.prod([lhs.shape[i] for i in lc], dtype=np.int64)) if lc else 1
+    m = int(np.prod([d for i, d in enumerate(lhs.shape)
+                     if i not in set(lc) | set(lb)], dtype=np.int64))
+    n = int(np.prod([d for i, d in enumerate(rhs.shape)
+                     if i not in set(rc) | set(rb)], dtype=np.int64))
+    return 2 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> int:
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    # 2 * output elements * kernel-window size * input feature depth
+    dn = eqn.params["dimension_numbers"]
+    kshape = rhs.shape
+    spatial_k = int(np.prod([kshape[i] for i in dn.rhs_spec[2:]], dtype=np.int64))
+    cin = kshape[dn.rhs_spec[1]]
+    return 2 * int(np.prod(out.shape, dtype=np.int64)) * spatial_k * cin
+
+
+def count_matmul_flops(jaxpr) -> int:
+    """Total dot/conv FLOPs in a (closed) jaxpr, scans scaled by length."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            total += _dot_flops(eqn)
+        elif prim == "conv_general_dilated":
+            total += _conv_flops(eqn)
+        elif prim == "scan":
+            total += eqn.params["length"] * count_matmul_flops(
+                eqn.params["jaxpr"].jaxpr)
+        elif prim == "while":
+            # trip count unknown; count one body iteration
+            total += count_matmul_flops(eqn.params["body_jaxpr"].jaxpr)
+        elif prim in ("custom_vjp_call", "custom_jvp_call",
+                      "custom_vjp_call_jaxpr", "remat", "checkpoint"):
+            inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+            if inner is not None:
+                total += count_matmul_flops(getattr(inner, "jaxpr", inner))
+        elif prim in ("pjit", "jit", "xla_call", "closed_call", "core_call",
+                      "shard_map"):
+            inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            if inner is not None:
+                total += count_matmul_flops(getattr(inner, "jaxpr", inner))
+        elif prim == "cond":
+            branches = eqn.params.get("branches", ())
+            if branches:
+                total += max(count_matmul_flops(b.jaxpr) for b in branches)
+        elif prim == "pallas_call":
+            # kernel body runs once per grid cell (e.g. the flash-attention
+            # QK^T/PV block matmuls); grid product x body FLOPs
+            inner = eqn.params.get("jaxpr")
+            gm = eqn.params.get("grid_mapping")
+            grid = getattr(gm, "grid", ()) if gm is not None else ()
+            cells = int(np.prod([g for g in grid if isinstance(g, int)],
+                                dtype=np.int64)) if grid else 1
+            if inner is not None:
+                total += cells * count_matmul_flops(getattr(inner, "jaxpr", inner))
+    return total
+
+
+def forward_flops(fn, *args) -> int:
+    """Matmul FLOPs of one forward call (traced abstractly, no execution)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return count_matmul_flops(jaxpr.jaxpr)
+
+
+def mfu(fwd_flops_per_step: float, step_time_s: float, n_chips: int = 1,
+        device: typing.Optional[jax.Device] = None) -> float:
+    """Model FLOPs utilization: 3x forward FLOPs over peak (no remat credit)."""
+    return 3.0 * fwd_flops_per_step / step_time_s / (peak_flops(device) * n_chips)
