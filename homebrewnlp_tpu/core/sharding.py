@@ -91,14 +91,45 @@ def shard_params(params: ModelParameter, variables: typing.Dict[str, jax.Array],
 
 def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
                 mesh: Mesh) -> typing.Dict[str, jax.Array]:
-    """Batch arrays shard along their leading (batch) axis over 'data'."""
+    """Batch arrays shard along their leading (batch) axis over 'data'.
+
+    Single-process: a plain ``device_put`` with the NamedSharding.  Multi-host
+    (``jax.process_count() > 1``): every process holds only its per-process
+    slice of the global batch (the train loop feeds
+    ``slice_index=process_index``), so the slices are assembled into one
+    global array via ``jax.make_array_from_process_local_data`` — the named
+    equivalent of the reference's per-host infeed placement
+    (/root/reference/src/run/dataloader_placement.py:153-227).  A bare
+    ``device_put`` here would treat each process's slice as the full global
+    batch: wrong data on every host but host 0.
+    """
     out = {}
+    nproc = jax.process_count()
+    # under macro-batching the leading axis is the macro index; the batch
+    # axis (the one sharded over 'data' and split across processes) is 1
+    batch_axis = 1 if params.macro_batching > 1 else 0
     for key, value in batch.items():
         entries: typing.List[typing.Optional[str]] = [None] * value.ndim
-        if "data" in mesh.axis_names and value.ndim and \
-                value.shape[0] % mesh.shape["data"] == 0:
-            entries[0] = "data"
-        out[key] = jax.device_put(value, NamedSharding(mesh, PartitionSpec(*entries)))
+        global_shape = list(value.shape)
+        if "data" in mesh.axis_names and value.ndim > batch_axis:
+            if nproc > 1:
+                global_shape[batch_axis] *= nproc
+            if global_shape[batch_axis] % mesh.shape["data"] == 0:
+                entries[batch_axis] = "data"
+            elif nproc > 1:
+                # a replicated multi-host assembly is unservable: each process
+                # holds a distinct slice, so fail here with a clear message
+                # rather than deep inside make_array_from_process_local_data
+                raise ValueError(
+                    f"global batch {global_shape[batch_axis]} for {key!r} is "
+                    f"not divisible by the 'data' mesh axis "
+                    f"({mesh.shape['data']}) across {nproc} processes")
+        sharding = NamedSharding(mesh, PartitionSpec(*entries))
+        if nproc > 1:
+            out[key] = jax.make_array_from_process_local_data(
+                sharding, np.asarray(value), tuple(global_shape))
+        else:
+            out[key] = jax.device_put(value, sharding)
     return out
 
 

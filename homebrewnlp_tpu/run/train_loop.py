@@ -61,9 +61,15 @@ def _macro_batches(dataset, macro: int):
 
 def make_dataset(params: ModelParameter, repeat: bool = True):
     runs_log = read_runs_log(params)
-    dataset = TextDataset(params, params.train_batch_size,
+    # each process loads only its slice of the global batch; shard_batch
+    # assembles the slices via make_array_from_process_local_data
+    nproc = max(1, jax.process_count())
+    if params.train_batch_size % nproc:
+        raise ValueError(f"train_batch_size {params.train_batch_size} must "
+                         f"divide evenly over {nproc} processes")
+    dataset = TextDataset(params, params.train_batch_size // nproc,
                           slice_index=jax.process_index(),
-                          slice_count=max(1, jax.process_count()),
+                          slice_count=nproc,
                           runs_log=runs_log or None, repeat=repeat)
     return Prefetcher(_macro_batches(dataset, params.macro_batching),
                       depth=params.buffer_size)

@@ -1,0 +1,78 @@
+"""Worker for the 2-process multi-host input-assembly test.
+
+Run as: python _multihost_worker.py <coordinator_port> <process_id> <num_procs>
+with JAX_PLATFORMS=cpu and --xla_force_host_platform_device_count=4 so the
+two processes form one 8-device multi-controller CPU "pod".
+
+Each process feeds a DISTINCT per-process batch slice (rows filled with its
+process id); shard_batch must assemble them into one global batch
+(core/sharding.py shard_batch via jax.make_array_from_process_local_data —
+the named equivalent of the reference's per-host infeed placement,
+/root/reference/src/run/dataloader_placement.py:153-227).  The check reads
+back per-row sums of the global array: the first half must come from
+process 0, the second from process 1 — a plain device_put of the local slice
+(the pre-fix behavior) would make every host see its own slice as the whole
+batch instead.
+"""
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    import jax
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
+                               process_id=pid)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.core import sharding as shardlib
+
+    assert len(jax.devices()) == 4 * nproc, \
+        f"expected {4 * nproc} global devices, got {len(jax.devices())}"
+
+    global_batch = 8
+    cfg = {"model_mode": "gpt", "use_video": False, "use_language": True,
+           "sequence_length": 16, "features_per_head": 8, "heads": 2,
+           "depth": 1, "train_batch_size": global_batch, "vocab_size": 256,
+           "tpu_size": 4 * nproc,
+           "mesh_shape_override": {"data": 4 * nproc},
+           "model_path": "/tmp/multihost_worker_run"}
+    params = ModelParameter(cfg)
+    mesh = shardlib.build_mesh(params)
+
+    local = global_batch // nproc
+    batch = {"token_x": np.full((local, 16, 1), pid, np.int32),
+             "token_y": np.full((local, 16, 1), pid, np.int32)}
+    sharded = shardlib.shard_batch(params, batch, mesh)
+
+    g = sharded["token_x"]
+    assert g.shape == (global_batch, 16, 1), g.shape
+
+    # fully-replicated per-row sums: forces the cross-process gather so every
+    # process can check the other's rows actually landed in the global batch
+    rep = NamedSharding(mesh, PartitionSpec())
+    row_sums = jax.jit(lambda x: jnp.sum(x, axis=(1, 2)),
+                       out_shardings=rep)(g)
+    got = np.asarray(row_sums)
+    want = np.repeat(np.arange(nproc) * 16, local)
+    assert np.array_equal(got, want), (got, want)
+
+    # macro-batching path: leading axis is the macro index, batch axis is 1
+    params.macro_batching = 2
+    mb = {"token_x": np.full((2, local, 16, 1), pid, np.int32)}
+    g2 = shardlib.shard_batch(params, mb, mesh)["token_x"]
+    assert g2.shape == (2, global_batch, 16, 1), g2.shape
+    got2 = np.asarray(jax.jit(lambda x: jnp.sum(x, axis=(2, 3)),
+                              out_shardings=rep)(g2))
+    assert np.array_equal(got2, np.stack([want, want])), (got2, want)
+
+    print(f"worker {pid}: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

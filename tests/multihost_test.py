@@ -1,0 +1,70 @@
+"""Multi-host input assembly (VERDICT round-1 missing #1).
+
+Spawns TWO real jax processes (multi-controller, CPU, 4 virtual devices
+each) and verifies shard_batch assembles distinct per-process dataset slices
+into one global sharded batch via jax.make_array_from_process_local_data —
+the rebuild's equivalent of the reference's per-host infeed placement
+(/root/reference/src/run/dataloader_placement.py:153-227).
+"""
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def two_process_assembly_test():
+    port = _free_port()
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS=flags + " --xla_force_host_platform_device_count=4")
+    worker = os.path.join(HERE, "_multihost_worker.py")
+    procs = [subprocess.Popen([sys.executable, worker, str(port), str(pid), "2"],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"worker {pid}: OK" in out, out
+
+
+def single_process_macro_axis_test():
+    """shard_batch shards the batch axis (axis 1 under macro-batching), never
+    the macro axis."""
+    import jax
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.core import sharding as shardlib
+
+    cfg = {"model_mode": "gpt", "use_video": False, "use_language": True,
+           "sequence_length": 16, "features_per_head": 8, "heads": 2,
+           "depth": 1, "train_batch_size": 8, "vocab_size": 256,
+           "tpu_size": 8, "macro_batching": 2,
+           "mesh_shape_override": {"data": 8},
+           "model_path": "/tmp/macro_axis_run"}
+    params = ModelParameter(cfg)
+    mesh = shardlib.build_mesh(params)
+    batch = {"token_x": np.zeros((2, 8, 16, 1), np.int32)}
+    out = shardlib.shard_batch(params, batch, mesh)["token_x"]
+    spec = out.sharding.spec
+    assert len(spec) >= 2 and spec[0] is None and spec[1] == "data", spec
