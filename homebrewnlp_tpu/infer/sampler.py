@@ -62,12 +62,35 @@ def make_sampler(model: Model) -> typing.Callable:
 
 def init_decode_caches(model: Model, variables, token_x) -> dict:
     """Zero-filled cache pytree for ``make_kv_sampler`` (structure discovered
-    abstractly via eval_shape — no device compute)."""
+    abstractly via eval_shape — no device compute).
+
+    When the decode scan engages, the caches are returned DEPTH-STACKED
+    (``model.blocks.stack_decode_caches``) so the sampler's while_loop carry
+    feeds the scan as xs directly — the per-token flat<->stacked restack was
+    hundreds of MB of HBM traffic per token at flagship size
+    (docs/PERFORMANCE.md 'Decoding').  Falls back to the flat layout when a
+    stacked carry wouldn't round-trip (e.g. non-homogeneous stacks where the
+    decode body unrolls and resolves flat names)."""
+    from ..model import blocks as blocks_mod
+
     tok0 = token_x[:, :1]
     shapes = jax.eval_shape(
         lambda v, t: model.apply_decode(v, t, jnp.int32(0), {})[1],
         variables, tok0)
-    return {k: jnp.zeros(v.shape, v.dtype) for k, v in shapes.items()}
+    flat = {k: jnp.zeros(v.shape, v.dtype) for k, v in shapes.items()}
+    stacked = blocks_mod.stack_decode_caches(model.params, flat)
+    if not any(k.startswith(blocks_mod.STACKED_CACHE_PREFIX) for k in stacked):
+        return flat
+    try:
+        out_shapes = jax.eval_shape(
+            lambda v, t, c: model.apply_decode(v, t, jnp.int32(0), c)[1],
+            variables, tok0, stacked)
+    except Exception:
+        return flat
+    same_structure = (set(out_shapes) == set(stacked)
+                      and all(out_shapes[k].shape == tuple(stacked[k].shape)
+                              for k in stacked))
+    return stacked if same_structure else flat
 
 
 def make_kv_sampler(model: Model) -> typing.Callable:
@@ -202,7 +225,11 @@ def sample_video(model: Model, variables, batch, initial_pos=None,
                                                **({"token_x": jnp.asarray(token_x)}
                                                   if token_x is not None else {})})
         # frame_out[:, t] / token_out[:, t] predict position t+1 (src/tgt
-        # shift: data tgt = frames[1:], token_y = tokens[1:])
+        # shift: data tgt = frames[1:], token_y = tokens[1:]).  The reference
+        # writes its prediction at the unshifted position
+        # (/root/reference/src/run/inference.py body_fn, near its own
+        # "todo: fix token shift") — the shift here deliberately corrects
+        # that off-by-one rather than reproducing it.
         pred = np.asarray(out_frame)[:, pos - 1]
         frame[:, pos] = pred * 255.0
         if token_x is not None:

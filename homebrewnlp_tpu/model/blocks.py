@@ -427,6 +427,72 @@ def _forward_recurrence(strategy: str, alpha: float, pairs, carry,
     return (x,)
 
 
+# marker prefix for depth-stacked decode-cache keys (leading axis = depth)
+STACKED_CACHE_PREFIX = "__stacked__/"
+
+_CACHE_BLOCK_RE = None
+
+
+def _cache_block_re():
+    global _CACHE_BLOCK_RE
+    if _CACHE_BLOCK_RE is None:
+        import re
+        _CACHE_BLOCK_RE = re.compile(r"block(\d+)_(\d+)_")
+    return _CACHE_BLOCK_RE
+
+
+def stack_decode_caches(params: ModelParameter,
+                        flat: typing.Dict[str, jax.Array]
+                        ) -> typing.Dict[str, jax.Array]:
+    """Group per-depth block caches into ``[depth, ...]`` arrays keyed
+    ``__stacked__/<depth-0 name>``; non-block (and incomplete) caches pass
+    through flat.  Keeping the sampler's while_loop carry in this layout
+    removes the per-token flat<->stacked restack inside the decode scan
+    (hundreds of MB of HBM traffic per token at flagship size —
+    docs/PERFORMANCE.md 'Decoding')."""
+    block_re = _cache_block_re()
+    groups: typing.Dict[str, typing.Dict[int, str]] = {}
+    out: typing.Dict[str, jax.Array] = {}
+    for name, arr in flat.items():
+        m = block_re.search(name)
+        if m is None or int(m.group(1)) >= params.depth:
+            out[name] = arr
+            continue
+        rel = name[:m.start()] + f"block0_{m.group(2)}_" + name[m.end():]
+        groups.setdefault(rel, {})[int(m.group(1))] = name
+    for rel, per in groups.items():
+        if set(per) != set(range(params.depth)):
+            for name in per.values():
+                out[name] = flat[name]
+            continue
+        try:
+            out[STACKED_CACHE_PREFIX + rel] = jnp.stack(
+                [flat[per[i]] for i in range(params.depth)])
+        except (ValueError, TypeError):
+            for name in per.values():
+                out[name] = flat[name]
+    return out
+
+
+def unstack_decode_caches(params: ModelParameter,
+                          mixed: typing.Dict[str, jax.Array]
+                          ) -> typing.Dict[str, jax.Array]:
+    """Inverse of :func:`stack_decode_caches` (flat per-block names)."""
+    block_re = _cache_block_re()
+    out: typing.Dict[str, jax.Array] = {}
+    for name, arr in mixed.items():
+        if not name.startswith(STACKED_CACHE_PREFIX):
+            out[name] = arr
+            continue
+        rel = name[len(STACKED_CACHE_PREFIX):]
+        m = block_re.search(rel)
+        assert m is not None, rel
+        for i in range(params.depth):
+            flat_name = rel[:m.start()] + f"block{i}_{m.group(2)}_" + rel[m.end():]
+            out[flat_name] = arr[i]
+    return out
+
+
 def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
                      strategy: str, attn_base: int
                      ) -> typing.Optional[NamedTensor]:
@@ -435,13 +501,13 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
     The unrolled decode while_loop body issues thousands of tiny kernels per
     token at depth 32 (measured 207 ms/token vs 4 ms at depth 2 — pure
     dispatch overhead); scanning bounds the program to one iteration.  KV
-    caches are name-keyed per block: they are stacked on a leading depth
-    axis as scan xs, the per-iteration updates come back as scan ys, and the
-    flat per-block names are restored afterwards so the sampler's while_loop
-    carry structure is unchanged.  Runs only when the cache dict is complete
-    and depth-homogeneous (the discovery pass with empty caches stays
-    unrolled and defines those names)."""
-    import re
+    caches are name-keyed per block.  Preferred layout: the sampler carries
+    them depth-STACKED (``stack_decode_caches``) so they feed the scan as xs
+    and the updates return as ys with ZERO per-token restacking.  A flat
+    carry still works (stacked on entry, unstacked on exit) for callers that
+    never adopted the stacked layout.  Runs only when the cache dict is
+    complete and depth-homogeneous (the discovery pass with empty caches
+    stays unrolled and defines those names)."""
     from . import decode as decode_mod
     state = ctx.decode
     if not state.caches:
@@ -451,31 +517,29 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
         return None
     stacked_params, shared, fns = pro
 
-    # group cache names by depth, mapping each to its depth-0 form
-    # (non-block caches need no handling: DecodeState.out starts as a copy
-    # of the full cache dict, so they pass through unchanged)
-    block_re = re.compile(r"block(\d+)_(\d+)_")
-    per_depth_caches: typing.List[typing.Dict[str, str]] = \
-        [{} for _ in range(params.depth)]
-    for name in state.caches:
-        m = block_re.search(name)
-        if m is None:
-            continue
-        i = int(m.group(1))
-        if i >= params.depth:
+    block_re = _cache_block_re()
+    stacked_in = {k[len(STACKED_CACHE_PREFIX):]: v
+                  for k, v in state.caches.items()
+                  if k.startswith(STACKED_CACHE_PREFIX)}
+    if stacked_in:
+        # stacked carry: rel names are the keys; nothing to regroup
+        if any(v.shape[0] != params.depth for v in stacked_in.values()):
             return None
-        rel = name[:m.start()] + f"block0_{m.group(2)}_" + name[m.end():]
-        per_depth_caches[i][rel] = name
-    rel_cache_names = set(per_depth_caches[0])
-    if any(set(d) != rel_cache_names for d in per_depth_caches[1:]):
-        return None
-    try:
-        stacked_caches = {
-            rel: jnp.stack([state.caches[per_depth_caches[i][rel]]
-                            for i in range(params.depth)])
-            for rel in rel_cache_names}
-    except (ValueError, TypeError):
-        return None
+        stacked_caches = stacked_in
+    else:
+        # flat carry: one restack on entry (non-block caches need no
+        # handling: DecodeState.out starts as a copy of the full cache dict,
+        # so they pass through unchanged).  Any block-named cache that
+        # stack_decode_caches could NOT fold (depth-incomplete / ragged)
+        # means the stack is not homogeneous: bail to the unrolled body.
+        regrouped = stack_decode_caches(params, state.caches)
+        if any(not k.startswith(STACKED_CACHE_PREFIX) and block_re.search(k)
+               for k in regrouped):
+            return None
+        stacked_caches = {k[len(STACKED_CACHE_PREFIX):]: v
+                          for k, v in regrouped.items()
+                          if k.startswith(STACKED_CACHE_PREFIX)}
+    rel_cache_names = set(stacked_caches)
 
     alpha = params.momentumnet_alpha
 
@@ -501,10 +565,19 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
     carry, cache_updates = jax.lax.scan(step, carry0,
                                         (stacked_params, stacked_caches))
     for rel, arr in cache_updates.items():
-        if rel not in per_depth_caches[0]:
-            continue  # cache born inside the scan: not part of the carry
-        for i in range(params.depth):
-            state.out[per_depth_caches[i][rel]] = arr[i]
+        # the discovery pass defines every cache name before the scan runs;
+        # a cache born lazily inside the scan would be silently dropped from
+        # the carry (corrupting decode), so fail loudly instead
+        assert rel in rel_cache_names, (
+            f"decode cache {rel!r} created inside the scan body; it is not "
+            f"part of the sampler carry — the discovery-pass invariant is "
+            f"violated")
+        if stacked_in:
+            # scan ys are already depth-stacked: write back verbatim
+            state.out[STACKED_CACHE_PREFIX + rel] = arr
+        else:
+            state.out.update(unstack_decode_caches(
+                params, {STACKED_CACHE_PREFIX + rel: arr}))
     *streams, _ = carry
     return sum(streams[1:], streams[0])
 

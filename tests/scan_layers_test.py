@@ -138,3 +138,39 @@ def scan_with_dropout_matches_test():
             np.asarray(state_s.variables[name]),
             np.asarray(state_u.variables[name]), rtol=2e-4, atol=2e-6,
             err_msg=name)
+
+
+def decode_carry_is_stacked_test():
+    """init_decode_caches returns the depth-STACKED cache layout when the
+    decode scan engages, so the while_loop carry feeds the scan as xs with
+    no per-token flat<->stacked restack (docs/PERFORMANCE.md 'Decoding');
+    and the stacked round-trip is lossless."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.model import blocks
+    from homebrewnlp_tpu.infer import sampler
+
+    params = _cfg("revnet", scan=True, depth=3, train_batch_size=1)
+    model = Model(params)
+    variables = {k: jnp.asarray(v) for k, v in model.init(
+        {"token_x": np.zeros((1, params.sequence_length, 1), np.int32),
+         "token_y": np.zeros((1, params.sequence_length, 1), np.int32)}).items()}
+    tok = jnp.zeros((1, params.sequence_length, 1), jnp.int32)
+    caches = sampler.init_decode_caches(model, variables, tok)
+    stacked_keys = [k for k in caches
+                    if k.startswith(blocks.STACKED_CACHE_PREFIX)]
+    assert stacked_keys, "decode carry fell back to the flat layout"
+    for k in stacked_keys:
+        assert caches[k].shape[0] == params.depth, (k, caches[k].shape)
+
+    # round-trip: unstack -> stack reproduces keys and shapes exactly
+    flat = blocks.unstack_decode_caches(params, caches)
+    restacked = blocks.stack_decode_caches(params, flat)
+    assert set(restacked) == set(caches)
+    for k in caches:
+        assert restacked[k].shape == caches[k].shape
+
+    # and the sampler still decodes greedily through the stacked carry
+    out = sampler.sample_text(model, variables,
+                              np.asarray([[1, 2, 3]], np.int32),
+                              temperature=0.0, seed=0)
+    assert out.shape[1] == params.sequence_length
