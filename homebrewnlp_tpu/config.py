@@ -243,6 +243,15 @@ class ModelParameter:
             self.features_per_head = self.features // self.heads
         if self.use_video and (self.frame_width * self.frame_height // self.patch_size) % self.experts:
             raise ValueError("Frame size has to be divisible by number of experts")
+        if self.use_video and self.use_language and self.three_axes:
+            # the reference's text+frame concat joins txt [b, seq, height(ltp),
+            # h, k] with a rank-6 three-axes frame tensor — rank-mismatched in
+            # mtf too (/root/reference/src/dataclass.py:334,
+            # src/model/__init__.py:88); only the folded single-spatial-axis
+            # layout has well-defined concat/slice semantics
+            raise ValueError("use_video + use_language requires "
+                             "three_axes=false (height and width fold into "
+                             "one spatial axis that text tokens join on)")
         if self.intermediate_feed_forward_multiplier_multiplier is not None:
             self.intermediate_feed_forward_multiplier = (
                 self.group_linear_factor
