@@ -1,0 +1,167 @@
+"""WebVTT subtitle decoding + word-timestamp -> token alignment.
+
+Reference: /root/reference/scripts/video2tfrecord.py:186-361 (decode_vtt,
+bpe_with_word_split) and the per-frame token grouping of its worker loop
+(:684-707).  Two VTT flavours are handled:
+
+* word-level timing (YouTube auto-captions): ``word<00:00:01.319><c> next</c>``
+  — every word carries its own stamp;
+* plain cues: ``00:00:01.000 --> 00:00:04.000`` followed by text lines — the
+  cue's span is divided evenly over its words.
+
+``split_tokens_on_words`` re-splits a whole-text tokenisation back onto the
+timestamped words (tokenising word-by-word would change merges across word
+boundaries), and ``frames_token_groups`` reproduces the reference's frame
+loop semantics: all words whose stamp falls before the end of a sampled
+frame's interval belong to that frame; tokens chunk into groups of
+``ltp - 1``; the first group rides the real frame, overflow groups ride
+padding frames flagged ``skip_frame``; ``mask`` is the count of real
+(non-padding) tokens.
+"""
+from __future__ import annotations
+
+import re
+import typing
+
+_STAMP = re.compile(r"(\d+):(\d{2}):(\d{2})[.,](\d{3})")
+_WORD_TIMED = re.compile(r"<(\d+):(\d{2}):(\d{2})[.,](\d{3})>")
+_TAG = re.compile(r"<[^>]*>")
+
+
+def _seconds(h, m, s, ms) -> float:
+    return int(h) * 3600 + int(m) * 60 + int(s) + int(ms) / 1000.0
+
+
+def decode_vtt(content: str) -> typing.Tuple[str, typing.List[str], typing.List[float]]:
+    """-> (full_text, words, stamps): one timestamped chunk per entry.
+
+    Word-level markup when present; otherwise cue ranges with the span
+    linearly interpolated across the cue's words (reference decode_vtt,
+    video2tfrecord.py:188-304)."""
+    if "</c><" in content and "><c>" in content:
+        words: typing.List[str] = []
+        stamps: typing.List[float] = []
+        cue_start: typing.Optional[float] = None
+        for line in content.split("\n"):
+            if " --> " in line:
+                m = _STAMP.findall(line)
+                cue_start = _seconds(*m[0]) if m else None
+                continue
+            if "<c>" not in line:
+                continue
+            pieces = _WORD_TIMED.split(line)
+            # pieces = [word0, h, m, s, ms, word1, h, m, s, ms, word2, ...];
+            # an inline stamp marks the START of the word that follows it;
+            # the line's leading (untimed) word starts at the cue header time
+            first = _TAG.sub("", pieces[0]).strip()
+            if first:
+                start = cue_start if cue_start is not None else (
+                    _seconds(*pieces[1:5]) if len(pieces) >= 5 else 0.0)
+                words.append(" " + first)
+                stamps.append(start)
+            idx = 1
+            while idx + 4 <= len(pieces):
+                stamp = _seconds(*pieces[idx:idx + 4])
+                word = _TAG.sub("", pieces[idx + 4]).strip()
+                if word:
+                    words.append(" " + word)
+                    stamps.append(stamp)
+                idx += 5
+        return "".join(words), words, stamps
+
+    # plain cue format
+    lines = content.split("\n")
+    words = []
+    stamps = []
+    i = 0
+    while i < len(lines):
+        if " --> " not in lines[i]:
+            i += 1
+            continue
+        m = _STAMP.findall(lines[i])
+        i += 1
+        text_lines = []
+        while i < len(lines) and lines[i].strip() and " --> " not in lines[i]:
+            text_lines.append(_TAG.sub("", lines[i]))
+            i += 1
+        if len(m) < 2:
+            continue
+        start, end = _seconds(*m[0]), _seconds(*m[1])
+        cue_words = [w for w in " ".join(text_lines).split() if w]
+        if not cue_words:
+            continue
+        snip = (end - start) / len(cue_words)
+        for j, w in enumerate(cue_words):
+            words.append(" " + w)
+            stamps.append(start + j * snip)
+    return "".join(words), words, stamps
+
+
+def split_tokens_on_words(encode: typing.Callable[[str], typing.List[int]],
+                          decode: typing.Callable[[typing.List[int]], str],
+                          words: typing.List[str], text: str
+                          ) -> typing.List[typing.List[int]]:
+    """Tokenise the FULL text once, then greedily walk the token strings back
+    onto the timestamped words so merges across word boundaries survive
+    (reference bpe_with_word_split, video2tfrecord.py:307-361).  Returns one
+    token list per word; a token spanning two words is assigned to the first.
+    """
+    tokens = encode(text)
+    out: typing.List[typing.List[int]] = []
+    idx = 0
+    for word in words:
+        buf: typing.List[int] = []
+        remaining = word.replace(" ", "")
+        while idx < len(tokens) and remaining:
+            # a single token may not decode alone (e.g. one byte of a
+            # multi-byte character under the byte codec): accumulate a short
+            # run of tokens until their JOINT decode matches the word prefix
+            matched = 0
+            for k in range(1, min(8, len(tokens) - idx) + 1):
+                ts = decode(tokens[idx:idx + k]).replace(" ", "")
+                if ts and remaining.startswith(ts):
+                    matched = k
+                    remaining = remaining[len(ts):]
+                    break
+                if ts and not remaining.startswith(ts[:1]) \
+                        and "�" not in ts:
+                    break  # clean decode that disagrees: token of next word
+            if matched == 0:
+                break
+            buf.extend(tokens[idx:idx + matched])
+            idx += matched
+        out.append(buf)
+    # anything the walk couldn't place (tokenizer normalisation drift) rides
+    # with the final word so no token is silently dropped
+    if idx < len(tokens) and out:
+        out[-1].extend(tokens[idx:])
+    return out
+
+
+def frames_token_groups(bpe_list: typing.List[typing.List[int]],
+                        stamps: typing.List[float],
+                        frame_end_s: float,
+                        ltp: int, padding_token: int,
+                        state: dict) -> typing.List[typing.Tuple[typing.List[int], int, bool]]:
+    """Token groups for one sampled frame ending at ``frame_end_s``.
+
+    ``state['idx']`` tracks consumption across calls.  Returns
+    ``[(tokens, mask, skip_frame), ...]``: at least one group (all-padding,
+    mask 0 when no words fall in the interval); overflow groups are flagged
+    skip_frame=True and ride padding frames (reference worker loop,
+    video2tfrecord.py:684-707)."""
+    idx = state.setdefault("idx", 0)
+    buf: typing.List[int] = []
+    while idx < len(stamps) and stamps[idx] < frame_end_s:
+        buf.extend(bpe_list[idx])
+        idx += 1
+    state["idx"] = idx
+    if not buf:
+        return [([padding_token] * ltp, 0, False)]
+    groups = []
+    for i in range(0, len(buf), max(1, ltp - 1)):
+        chunk = buf[i:i + ltp - 1]
+        mask = len(chunk)
+        chunk = chunk + [padding_token] * (ltp - mask)
+        groups.append((chunk, mask, i > 0))
+    return groups

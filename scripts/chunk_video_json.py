@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Chunk video id/duration lists into work units of at least a minimum total
+duration (reference: /root/reference/scripts/chunk_video_json.py:1-86 —
+sibling of split_video_json.py, which balance-splits across a fixed worker
+count; this one greedily packs shuffled videos until each chunk reaches
+``min_duration`` seconds).
+
+Input json: {"id": [...], "duration": [...]} — one file or a directory of
+them.  Output: {prefix}work_chunks.json with {"id": [[...], ...],
+"duration": [[...], ...]}.
+"""
+import argparse
+import json
+import os
+import random
+
+
+def chunk(ids, durations, min_duration, seed=None):
+    videos = list(zip(ids, durations))
+    rng = random.Random(seed)
+    rng.shuffle(videos)
+    chunks_ids, chunks_dur = [], []
+    cur_ids, cur_dur, cur_sum = [], [], 0
+    for vid, dur in videos:
+        cur_ids.append(vid)
+        cur_dur.append(dur)
+        cur_sum += dur
+        if cur_sum >= min_duration:
+            chunks_ids.append(cur_ids)
+            chunks_dur.append(cur_dur)
+            cur_ids, cur_dur, cur_sum = [], [], 0
+    if cur_ids:
+        chunks_ids.append(cur_ids)
+        chunks_dur.append(cur_dur)
+    return chunks_ids, chunks_dur
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("load_path",
+                    help="json file with video info, or a directory of them")
+    ap.add_argument("min_duration", type=int,
+                    help="minimum total seconds per chunk")
+    ap.add_argument("-prefix", type=str, default="", help="save-file prefix")
+    ap.add_argument("-seed", type=int, default=None,
+                    help="shuffle seed (reference shuffles unseeded)")
+    args = ap.parse_args()
+
+    paths = ([os.path.join(args.load_path, p)
+              for p in sorted(os.listdir(args.load_path))]
+             if os.path.isdir(args.load_path) else [args.load_path])
+    ids, durations = [], []
+    for p in paths:
+        with open(p) as f:
+            data = json.load(f)
+        ids += data["id"]
+        durations += data["duration"]
+
+    chunks_ids, chunks_dur = chunk(ids, durations, args.min_duration,
+                                   args.seed)
+    total_videos = total_dur = 0
+    for i, (ci, cd) in enumerate(zip(chunks_ids, chunks_dur)):
+        print(f"chunk: {i} videos: {len(ci)} duration: {sum(cd)}")
+        total_videos += len(ci)
+        total_dur += sum(cd)
+    print(f"\ntotal num of videos: {total_videos} "
+          f"total video duration: {total_dur}")
+
+    out = f"{args.prefix}work_chunks.json"
+    with open(out, "w") as f:
+        json.dump({"id": chunks_ids, "duration": chunks_dur}, f)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,11 +4,22 @@
 Equivalent of the reference's /root/reference/scripts/video2tfrecord.py proto
 layout: one record per frame with features ``frame`` (encoded JPEG),
 ``concat`` (1 on the first frame of each new clip), ``skip_frame`` and —
-with --captions — ``tokens`` + ``mask`` (token count valid for the frame).
-The reference additionally streamed from YouTube with proxy rotation and
-aligned VTT subtitles word-by-word (:57-343); this zero-egress variant takes
-local video files (anything cv2 opens) and optional per-video caption .txt
-files, tokenised byte-level or with a tokenizer.json.
+with text — ``tokens`` + ``mask`` (token count valid for the frame).
+
+Text sources, in precedence order per video:
+
+* ``<video>.vtt`` — WebVTT subtitles: word timestamps are aligned to tokens
+  per frame exactly like the reference (decode_vtt + bpe_with_word_split +
+  the worker frame loop, video2tfrecord.py:186-361,684-707): tokens of all
+  words falling in a sampled frame's interval chunk into groups of
+  ``ltp - 1``; the first group rides the real frame, overflow groups ride
+  black padding frames flagged ``skip_frame``; ``mask`` counts real tokens.
+* ``<video>.txt`` — whole-video caption, truncated to one frame's tokens
+  (with --captions).
+
+The reference additionally streamed from YouTube with proxy rotation; this
+zero-egress variant takes local video files (anything cv2 opens), tokenised
+byte-level or with a tokenizer.json.
 """
 import argparse
 import os
@@ -17,6 +28,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example  # noqa: E402
+from homebrewnlp_tpu.data import vtt as vtt_mod  # noqa: E402
 
 
 def _tokens_for(text: str, n: int, tokenizer):
@@ -27,6 +39,14 @@ def _tokens_for(text: str, n: int, tokenizer):
     ids = ids[:n]
     mask = len(ids)
     return ids + [0] * (n - len(ids)), mask
+
+
+def _make_codec(tokenizer):
+    if tokenizer is not None:
+        return (lambda t: tokenizer.encode(t).ids,
+                lambda ids: tokenizer.decode(ids))
+    return (lambda t: list(t.encode("utf-8", "replace")),
+            lambda ids: bytes(ids).decode("utf-8", "replace"))
 
 
 def main():
@@ -41,7 +61,13 @@ def main():
     ap.add_argument("--frames-per-file", type=int, default=4096)
     ap.add_argument("--captions", action="store_true",
                     help="read <video>.txt captions into tokens/mask")
+    ap.add_argument("--subtitles", action="store_true",
+                    help="align <video>.vtt word timestamps to per-frame "
+                         "tokens (reference video2tfrecord semantics)")
     ap.add_argument("--language-tokens-per-frame", type=int, default=64)
+    ap.add_argument("--padding-token", type=int, default=None,
+                    help="default: 50257 with --tokenizer (GPT-2 style pad "
+                         "id), 0 for the byte-level fallback (vocab 256)")
     ap.add_argument("--tokenizer", default="", help="optional tokenizer.json")
     args = ap.parse_args()
 
@@ -49,6 +75,11 @@ def main():
     if args.tokenizer:
         from tokenizers import Tokenizer
         tokenizer = Tokenizer.from_file(args.tokenizer)
+    if args.padding_token is None:
+        args.padding_token = 50257 if tokenizer is not None else 0
+    if args.subtitles and args.language_tokens_per_frame < 2:
+        ap.error("--subtitles needs --language-tokens-per-frame >= 2 "
+                 "(one slot is reserved for chunking)")
 
     os.makedirs(args.output_dir, exist_ok=True)
     file_idx = 0
@@ -66,7 +97,21 @@ def main():
         frames_in_file = 0
         print(f"writing {path}")
 
+    import numpy as np
     new_writer()
+    ltp = args.language_tokens_per_frame
+    ok_pad, pad_jpg = cv2.imencode(
+        ".jpg", np.zeros((args.height, args.width, 3), np.uint8))
+    assert ok_pad
+    pad_jpg = pad_jpg.tobytes()
+
+    def emit(features):
+        nonlocal frames_in_file
+        writer.write(encode_example(features))
+        frames_in_file += 1
+        if frames_in_file >= args.frames_per_file:
+            new_writer()
+
     for video_path in args.videos:
         cap = cv2.VideoCapture(video_path)
         src_fps = cap.get(cv2.CAP_PROP_FPS) or 25.0
@@ -75,6 +120,13 @@ def main():
         cap_path = os.path.splitext(video_path)[0] + ".txt"
         if args.captions and os.path.exists(cap_path):
             caption = open(cap_path, errors="ignore").read()
+        bpe_list, stamps, vtt_state = None, None, {}
+        vtt_path = os.path.splitext(video_path)[0] + ".vtt"
+        if args.subtitles and os.path.exists(vtt_path):
+            text, words, stamps = vtt_mod.decode_vtt(
+                open(vtt_path, errors="ignore").read())
+            enc_fn, dec_fn = _make_codec(tokenizer)
+            bpe_list = vtt_mod.split_tokens_on_words(enc_fn, dec_fn, words, text)
         i = 0
         first = True
         while True:
@@ -84,25 +136,35 @@ def main():
             if i % stride:
                 i += 1
                 continue
+            frame_end_s = (i + stride) / src_fps
             i += 1
             frame = cv2.resize(frame, (args.width, args.height))
             ok, enc = cv2.imencode(".jpg", frame,
                                    [cv2.IMWRITE_JPEG_QUALITY, 95])
             if not ok:
                 continue
+            if bpe_list is not None:
+                # word-timestamp alignment: first token group rides the real
+                # frame, overflow groups ride padding frames (skip_frame=1)
+                groups = vtt_mod.frames_token_groups(
+                    bpe_list, stamps, frame_end_s, ltp, args.padding_token,
+                    vtt_state)
+                for toks, mask, skip in groups:
+                    emit({"frame": pad_jpg if skip else enc.tobytes(),
+                          "concat": [1 if (first and not skip) else 0],
+                          "skip_frame": [1 if skip else 0],
+                          "tokens": toks, "mask": [mask]})
+                    first = False
+                continue
             features = {"frame": enc.tobytes(),
                         "concat": [1 if first else 0],
                         "skip_frame": [0]}
             if args.captions:
-                toks, mask = _tokens_for(caption, args.language_tokens_per_frame,
-                                         tokenizer)
+                toks, mask = _tokens_for(caption, ltp, tokenizer)
                 features["tokens"] = toks
                 features["mask"] = [mask]
-            writer.write(encode_example(features))
+            emit(features)
             first = False
-            frames_in_file += 1
-            if frames_in_file >= args.frames_per_file:
-                new_writer()
         cap.release()
     writer.close()
 
