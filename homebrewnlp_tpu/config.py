@@ -145,6 +145,10 @@ class ModelParameter:
         self.embedding_stddev = 0.04
         self.color_quantization_value = 256
         self.experts = 64
+        # routed (top-k) MoE defaults; per-layer flags top_k<k> /
+        # capacity_factor<f> on the routed mixture_of_experts override these
+        self.moe_top_k = 1
+        self.moe_capacity_factor = 1.25
         self.pkm_axes = 2
         self.use_bit_fold_input_pipeline = False
         self.bit_fold_value = 4
@@ -178,6 +182,11 @@ class ModelParameter:
         self.layout_override: typing.Dict[str, str] = {}  # dim name -> mesh axis
         self.pipeline_stages = 1          # GPipe stages over the 'pipe' mesh axis
         self.pipeline_microbatches: typing.Optional[int] = None  # default = stages
+        # "gpipe" (default): forward pipeline, autodiff backward.  "1f1b":
+        # fused forward+backward schedule with the loss head inside the last
+        # stage — O(stages) activation stash instead of O(microbatches)
+        # (parallel/pipeline_1f1b.py; text models, linear loss only)
+        self.pipeline_schedule = "gpipe"
         # lax.scan over depth: O(1) program size + bounded live activations
         # (falls back to unrolled blocks when the stack isn't homogeneous)
         self.scan_layers = True
