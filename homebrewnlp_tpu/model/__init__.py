@@ -297,6 +297,117 @@ class Model:
             info, _ = build(self.params, *args, plan=self.plan)
         return info
 
+    def train_grads_1f1b(self, variables: typing.Dict[str, jax.Array],
+                         batch: typing.Dict[str, jax.Array],
+                         rng: typing.Optional[jax.Array],
+                         mesh) -> typing.Tuple[typing.Dict[str, jax.Array],
+                                               LossInfo]:
+        """Loss + gradients via the fused 1F1B pipeline schedule
+        (parallel/pipeline_1f1b.py): the body runs the per-tick
+        forward/backward table with the output head + loss inside the last
+        stage; the input embedding and its gradients run outside through an
+        ordinary ``jax.vjp``.  Text models with the linear loss only."""
+        from ..parallel.pipeline_1f1b import pipeline_train_1f1b
+
+        p = self.params
+        assert self.plan is not None, "call init() first (or assign .plan)"
+        assert p.use_language and not p.use_video, \
+            "1f1b pipeline supports text (gpt) mode only"
+        assert not (p.contrastive_across_samples
+                    or p.contrastive_across_token_embeddings), \
+            "1f1b pipeline supports the plain xent loss only"
+        n_micro = max(1, int(p.pipeline_microbatches or mesh.shape["pipe"]))
+        if p.train_batch_size % n_micro:
+            raise ValueError(f"batch {p.train_batch_size} not divisible by "
+                             f"pipeline_microbatches={n_micro}")
+
+        ctx = scope.Context("apply", params=variables, rng_key=rng, mesh=mesh)
+        with scope.context(ctx):
+            (_, _, _, txt_src, txt_tgt, _, _, _) = self._named_inputs(batch)
+            p.attention_idx = 0
+            mode_frame = ctx.enter(p.model_mode)          # e.g. "gpt0"
+            spatial_ctx: Dim = txt_tgt.dims[-2]
+            input_names = [n for n in variables
+                           if n.startswith(f"{mode_frame}/input")]
+            head_names = [n for n in variables
+                          if n.startswith((f"{mode_frame}/output",
+                                           f"{mode_frame}/loss"))]
+
+            src_dims_box = []
+
+            def input_f(sub):
+                c = scope.Context("apply", params={**variables, **sub},
+                                  rng_key=rng, mesh=mesh)
+                c.stack.append(scope._Frame(mode_frame))
+                with scope.context(c):
+                    src, _ = scope.scoped("input", _input, p, None, None,
+                                          txt_src, None, spatial_ctx, {})
+                src_dims_box.append(src.dims)
+                return src.data
+
+            src_data, input_vjp = jax.vjp(
+                input_f, {n: variables[n] for n in input_names})
+            src_nt = nt(src_data, src_dims_box[0])
+
+            # body blocks exactly as run_body_blocks builds them
+            ctx.enter("body")
+            prefix = tuple(f.name for f in ctx.stack[1:])
+            from .blocks import ReplayBlock
+            blocks = [(i, c, bc) for i in range(p.depth)
+                      for c, bc in enumerate(p.block_config)]
+            fns, subsets = [], []
+            attn_idx = 0
+            for (i, c, bc), (_, _, names) in zip(blocks, self.plan):
+                fns.append(ReplayBlock(p, bc, i, c, prefix, attn_idx))
+                attn_idx += sum(layer.split('-')[0] == "attention"
+                                for layer in bc.layer)
+                subsets.append({n: variables[n] for n in names})
+            ctx.exit()
+            ctx.exit()  # mode frame
+
+            mb = p.train_batch_size // n_micro
+            src_dims_mb = (Dim(src_nt.dims[0].name, mb),) + tuple(src_nt.dims[1:])
+            tgt_dims_mb = (Dim(txt_tgt.dims[0].name, mb),) + tuple(txt_tgt.dims[1:])
+
+            def head_fn(head_sub, y_comb, tgt_data):
+                c = scope.Context("apply", params={**variables, **head_sub},
+                                  rng_key=rng, mesh=None)
+                c.stack.append(scope._Frame(mode_frame))
+                with scope.context(c):
+                    out_nt = nt(y_comb, src_dims_mb)
+                    tgt_nt = nt(tgt_data, tgt_dims_mb)
+                    frame_out, token_out = scope.scoped("output", _output, p,
+                                                        out_nt, spatial_ctx)
+                    loss_list, token_loss, accuracy, _ = scope.scoped(
+                        "loss", _loss, p, frame_out, token_out, tgt_nt, [],
+                        None, None, None, {})
+                total = add_n(loss_list).data
+                acc = accuracy.data if accuracy is not None else jnp.zeros(())
+                aux = jnp.stack([token_loss.data.astype(jnp.float32),
+                                 acc.astype(jnp.float32)])
+                return total, aux
+
+            tgt_mb = txt_tgt.data.reshape((n_micro, mb)
+                                          + txt_tgt.data.shape[1:])
+            loss, aux, body_grads, head_grads, d_src = pipeline_train_1f1b(
+                p, mesh, fns, subsets, self.plan, src_nt, tgt_mb, head_fn,
+                {n: variables[n] for n in head_names}, 2,
+                p.memory_reduction_strategy)
+            (d_input,) = input_vjp(d_src.data)
+            p.attention_idx = 0
+
+        grads = dict(body_grads)
+        for n, g in head_grads.items():
+            grads[n] = g.astype(variables[n].dtype)
+        for n, g in d_input.items():
+            grads[n] = g
+        for n in variables:
+            grads.setdefault(n, jnp.zeros_like(variables[n]))
+        loss_nt = nt(loss, ())
+        info = LossInfo(loss_nt, [loss_nt], None, nt(aux[1], ()),
+                        nt(aux[0], ()), None, None)
+        return grads, info
+
     def apply_decode(self, variables: typing.Dict[str, jax.Array],
                      token_slice: jax.Array, pos: jax.Array,
                      caches: typing.Dict[str, jax.Array],

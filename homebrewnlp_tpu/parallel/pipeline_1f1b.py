@@ -1,0 +1,360 @@
+"""Fused 1F1B pipeline schedule (opt-in: ``pipeline_schedule = "1f1b"``).
+
+GPipe (parallel/pipeline.py, the default) runs all M microbatch forwards,
+then autodiff generates the full backward — every stage stashes M microbatch
+residuals and the backward cannot start until the last forward finishes.
+1F1B interleaves them: each stage runs ``min(M, S - s)`` warmup forwards and
+then strictly alternates backward/forward, so at most ``S - s`` microbatches
+are ever in flight per stage (activation stash O(S) instead of O(M)) and the
+backward of microbatch 0 starts S ticks after its forward instead of M.
+
+That fusion is only possible with the output head + loss INSIDE the last
+stage (the backward of microbatch m needs its loss cotangent before the
+other microbatches have even run forward), so this module computes loss AND
+gradients in one forward-only pass: per-stage ``jax.vjp`` re-traces the
+existing strategy machinery (rev/momentum custom-vjp sequences, checkpoint)
+for the backward units, parameter gradients accumulate in the scan carry,
+and the schedule is a static per-tick table.  The reference has no pipeline
+parallelism at all (SURVEY.md §2.10); GPipe stays the default because its
+autodiff backward avoids 1F1B's per-unit forward recompute — choose 1f1b
+when activation memory or time-to-first-backward dominates.
+
+Text (gpt) models only; the multi-loss strategies (pcgrad/mgda) and
+contrastive losses keep the GPipe path.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.dims import Dim
+from ..core.tensor import NamedTensor, nt
+from .pipeline import AXIS, _stack_stages, _stage_layout
+
+Schedule = typing.Tuple[np.ndarray, np.ndarray]  # kinds, mbs: [ticks, S]
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+def build_schedule(n_micro: int, n_stages: int) -> Schedule:
+    """Static non-interleaved 1F1B table.
+
+    Per stage: ``min(M, S - s)`` warmup forwards, then strict B/F
+    alternation, then the trailing backwards; each unit fires at the
+    earliest tick its dependency allows (fwd: prev stage's fwd done;
+    bwd: next stage's bwd done, or the own-stage fwd for the last stage).
+    """
+    M, S = n_micro, n_stages
+    seq = []
+    for s in range(S):
+        warm = min(M, S - s)
+        units = [("F", m) for m in range(warm)]
+        for m in range(M - warm):
+            units.append(("B", m))
+            units.append(("F", warm + m))
+        units.extend(("B", m) for m in range(M - warm, M))
+        seq.append(units)
+
+    fwd_done = [[-1] * S for _ in range(M)]   # tick the unit completed
+    bwd_done = [[-1] * S for _ in range(M)]
+    pos = [0] * S
+    kinds, mbs = [], []
+    t = 0
+    while any(pos[s] < len(seq[s]) for s in range(S)):
+        krow, mrow = [IDLE] * S, [0] * S
+        fired = False
+        for s in range(S):
+            if pos[s] >= len(seq[s]):
+                continue
+            kind, m = seq[s][pos[s]]
+            if kind == "F":
+                ready = (s == 0 or (fwd_done[m][s - 1] >= 0
+                                    and fwd_done[m][s - 1] < t))
+            else:
+                own = fwd_done[m][s] >= 0 and fwd_done[m][s] < t
+                ready = own and (s == S - 1 or (bwd_done[m][s + 1] >= 0
+                                                and bwd_done[m][s + 1] < t))
+            if ready:
+                krow[s] = FWD if kind == "F" else BWD
+                mrow[s] = m
+                (fwd_done if kind == "F" else bwd_done)[m][s] = t
+                pos[s] += 1
+                fired = True
+        assert fired, "schedule deadlock"
+        kinds.append(krow)
+        mbs.append(mrow)
+        t += 1
+    return np.asarray(kinds, np.int32), np.asarray(mbs, np.int32)
+
+
+def bubble_ticks(kinds: np.ndarray) -> int:
+    """Idle (stage, tick) cells across the schedule — the pipeline bubble."""
+    return int((kinds == IDLE).sum())
+
+
+def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
+                        src: NamedTensor, tgt_mb: jax.Array,
+                        head_fn: typing.Callable,
+                        head_params: typing.Dict[str, jax.Array],
+                        n_aux: int, strategy: str):
+    """Fused forward+backward over the 'pipe' axis.
+
+    ``head_fn(head_params, y_combined, tgt) -> (loss, aux[n_aux])`` runs per
+    microbatch on the last stage.  Returns (mean loss, mean aux vector,
+    stage-stacked body grads ([S, ...] leaves, same tree as the stacked
+    params), head-param grads, d_src — the loss cotangent of ``src``).
+    """
+    from ..model.blocks import momentum_sequence, rev_sequence
+    from ..core import scope
+
+    n_stages = mesh.shape[AXIS]
+    n_micro = max(1, int(params.pipeline_microbatches or n_stages))
+    batch = src.dims[0]
+    if batch.size % n_micro:
+        raise ValueError(f"batch {batch.size} not divisible by "
+                         f"pipeline_microbatches={n_micro}")
+    mb = batch.size // n_micro
+    if mb % mesh.shape.get("data", 1):
+        raise ValueError(f"microbatch {mb} not divisible by data parallelism")
+
+    stage0_fns, name_lists, stage_leaves = _stage_layout(fns, subsets, plan,
+                                                         n_stages)
+    stacked = _stack_stages(stage_leaves)
+    kinds_np, mbs_np = build_schedule(n_micro, n_stages)
+    ticks = kinds_np.shape[0]
+    stash_slots = n_stages + 1
+    # a unit may fire LATER than one tick after its payload arrives (stages
+    # interleave B units), so receives are filed into per-microbatch slot
+    # buffers via static store tables instead of being consumed off the ring
+    # directly: f_store[t, s] = slot to store this tick's incoming forward
+    # activation (the payload stage s-1 sent at t-1), -1 = nothing arriving
+    f_store_np = np.full((ticks, n_stages), -1, np.int32)
+    b_store_np = np.full((ticks, n_stages), -1, np.int32)
+    for t in range(1, ticks):
+        for s in range(1, n_stages):
+            if kinds_np[t - 1, s - 1] == FWD:
+                f_store_np[t, s] = mbs_np[t - 1, s - 1] % stash_slots
+        for s in range(n_stages - 1):
+            if kinds_np[t - 1, s + 1] == BWD:
+                b_store_np[t, s] = mbs_np[t - 1, s + 1] % stash_slots
+    kinds = jnp.asarray(kinds_np)
+    mbs = jnp.asarray(mbs_np)
+    f_store = jnp.asarray(f_store_np)
+    b_store = jnp.asarray(b_store_np)
+
+    n_stream = 2 if strategy in ("revnet", "momentum") else 1
+    mb_dims = (Dim(batch.name, mb),) + tuple(src.dims[1:])
+    xm = src.data.reshape((n_micro, mb) + src.data.shape[1:])
+
+    def stage_apply(flat_params, state):
+        subs = [dict(zip(names, arrs))
+                for names, arrs in zip(name_lists, flat_params)]
+        if strategy == "revnet":
+            y1, y2 = rev_sequence(stage0_fns, tuple(subs),
+                                  nt(state[0], mb_dims), nt(state[1], mb_dims))
+            return jnp.stack([y1.data, y2.data])
+        if strategy == "momentum":
+            y, v = momentum_sequence(stage0_fns, params.momentumnet_alpha,
+                                     tuple(subs),
+                                     nt(state[0], mb_dims), nt(state[1], mb_dims))
+            return jnp.stack([y.data, v.data])
+        out = nt(state[0], mb_dims)
+        for f, sub in zip(stage0_fns, subs):
+            out = jax.checkpoint(f)(sub, out) if strategy == "checkpoint" \
+                else f(sub, out)
+        return out.data[None]
+
+    def combine(state):
+        return state[0] + state[1] if n_stream == 2 else state[0]
+
+    ctx = scope.current() if scope.in_context() else None
+    base_rng = ctx.rng_key if ctx is not None else None
+
+    def body(stacked_local, head_p, xm_local, tgt_local):
+        stage = jax.lax.axis_index(AXIS)
+        local = jax.tree.map(lambda a: jnp.squeeze(a, 0), stacked_local)
+        is_last = stage == n_stages - 1
+
+        def with_rng(m, fn, *args):
+            if ctx is None or base_rng is None:
+                return fn(*args)
+            # reset BOTH the folded key and the draw counter: the backward
+            # unit's vjp re-trace must consume identical next_rng() draws as
+            # the forward unit that produced the activation (the counter is
+            # Python trace state and would otherwise keep counting across
+            # units, giving the recompute different dropout masks)
+            saved_count = ctx._rng_count
+            ctx.rng_key = jax.random.fold_in(
+                jax.random.fold_in(base_rng, stage), m)
+            ctx._rng_count = 0
+            try:
+                return fn(*args)
+            finally:
+                ctx.rng_key = base_rng
+                ctx._rng_count = saved_count
+
+        state_shape = (n_stream, mb) + xm_local.shape[2:]
+        dtype = xm_local.dtype
+
+        def tick(carry, sched_row):
+            (f_recv, b_recv, stash, bstash, grads, hgrads, loss_acc, aux_acc,
+             d_src_acc) = carry
+            krow, mrow, frow, brow = sched_row
+            code = jnp.take(krow, stage)
+            m = jnp.take(mrow, stage)
+            slot = jnp.mod(m, stash_slots)
+
+            # file this tick's ring arrivals into their microbatch slots
+            fslot = jnp.take(frow, stage)
+            stash = jax.lax.cond(
+                fslot >= 0,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    stash, f_recv, jnp.maximum(fslot, 0), 0),
+                lambda: stash)
+            bslot = jnp.take(brow, stage)
+            bstash = jax.lax.cond(
+                bslot >= 0,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    bstash, b_recv, jnp.maximum(bslot, 0), 0),
+                lambda: bstash)
+
+            x0 = jax.lax.dynamic_index_in_dim(
+                xm_local, jnp.minimum(m, n_micro - 1), 0, keepdims=False)
+            state0 = jnp.broadcast_to(x0[None], state_shape).astype(dtype)
+            stashed = jax.lax.dynamic_index_in_dim(stash, slot, 0,
+                                                   keepdims=False)
+            x_in = jnp.where(stage == 0, state0, stashed)
+
+            def fwd_unit(_):
+                y = with_rng(m, stage_apply, local, x_in)
+                new_stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, x_in, slot, 0)
+                zg = jax.tree.map(jnp.zeros_like, grads)
+                zh = jax.tree.map(jnp.zeros_like, hgrads)
+                return (y, new_stash, zg, zh, jnp.float32(0),
+                        jnp.zeros((n_aux,), jnp.float32),
+                        jnp.zeros_like(x0), jnp.zeros(state_shape, dtype),
+                        jnp.int32(0))
+
+            def bwd_unit(_):
+                xs = jax.lax.dynamic_index_in_dim(stash, slot, 0,
+                                                  keepdims=False)
+                tgt = jax.lax.dynamic_index_in_dim(
+                    tgt_local, jnp.minimum(m, n_micro - 1), 0, keepdims=False)
+
+                def last_loss(p_, x_, h_):
+                    y_ = stage_apply(p_, x_)
+                    loss, aux = head_fn(h_, combine(y_), tgt)
+                    return loss, aux
+
+                def run_last():
+                    loss, vjp, aux = with_rng(
+                        m, lambda: jax.vjp(last_loss, local, xs, head_p,
+                                           has_aux=True))
+                    # the overall loss is the MEAN over microbatches: seed
+                    # each microbatch's backward with 1/M
+                    dparams, dx, dh = vjp(jnp.asarray(1.0 / n_micro,
+                                                      loss.dtype))
+                    dh = jax.tree.map(lambda a: a.astype(jnp.float32), dh)
+                    return (dparams, dh, dx, loss.astype(jnp.float32),
+                            aux.astype(jnp.float32))
+
+                def run_mid():
+                    cot = jax.lax.dynamic_index_in_dim(bstash, slot, 0,
+                                                       keepdims=False)
+                    _, vjp = with_rng(
+                        m, lambda: jax.vjp(stage_apply, local, xs))
+                    dparams, dx = vjp(cot)
+                    return (dparams, jax.tree.map(jnp.zeros_like, hgrads),
+                            dx, jnp.float32(0),
+                            jnp.zeros((n_aux,), jnp.float32))
+
+                dparams, dh, dx, loss, aux = jax.lax.cond(
+                    is_last, run_last, run_mid)
+                d_src = jnp.where(stage == 0, dx.sum(0), jnp.zeros_like(x0))
+                return (jnp.zeros(state_shape, dtype), stash, dparams, dh,
+                        loss, aux, d_src, dx, jnp.int32(1))
+
+            def idle_unit(_):
+                zg = jax.tree.map(jnp.zeros_like, grads)
+                zh = jax.tree.map(jnp.zeros_like, hgrads)
+                return (jnp.zeros(state_shape, dtype), stash, zg, zh,
+                        jnp.float32(0), jnp.zeros((n_aux,), jnp.float32),
+                        jnp.zeros_like(x0), jnp.zeros(state_shape, dtype),
+                        jnp.int32(0))
+
+            (send_f, stash, dg, dh, dloss, daux, d_src, send_b, wrote) = \
+                jax.lax.switch(code, [idle_unit, fwd_unit, bwd_unit],
+                               operand=None)
+            grads = jax.tree.map(jnp.add, grads, dg)
+            hgrads = jax.tree.map(jnp.add, hgrads, dh)
+            loss_acc = loss_acc + dloss
+            aux_acc = aux_acc + daux
+            prev = jax.lax.dynamic_index_in_dim(
+                d_src_acc, jnp.minimum(m, n_micro - 1), 0, keepdims=False)
+            d_src_acc = jax.lax.dynamic_update_index_in_dim(
+                d_src_acc, jnp.where(wrote > 0, d_src, prev),
+                jnp.minimum(m, n_micro - 1), 0)
+            f_recv = jax.lax.ppermute(
+                send_f, AXIS, [(i, i + 1) for i in range(n_stages - 1)])
+            b_recv = jax.lax.ppermute(
+                send_b, AXIS, [(i + 1, i) for i in range(n_stages - 1)])
+            return (f_recv, b_recv, stash, bstash, grads, hgrads, loss_acc,
+                    aux_acc, d_src_acc), None
+
+        carry0 = (
+            jnp.zeros(state_shape, dtype),
+            jnp.zeros(state_shape, dtype),
+            jnp.zeros((stash_slots,) + state_shape, dtype),
+            jnp.zeros((stash_slots,) + state_shape, dtype),
+            jax.tree.map(jnp.zeros_like, local),
+            jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), head_p),
+            jnp.float32(0),
+            jnp.zeros((n_aux,), jnp.float32),
+            jnp.zeros((n_micro,) + xm_local.shape[1:], xm_local.dtype),
+        )
+        (_, _, _, _, grads, hgrads, loss_acc, aux_acc, d_src_acc), _ = \
+            jax.lax.scan(tick, carry0, (kinds, mbs, f_store, b_store))
+        # grads live on their own stage; restore the leading stage axis for
+        # the out_spec.  head/loss/d_src live on single stages: psum over
+        # pipe replicates them.
+        grads = jax.tree.map(lambda a: a[None], grads)
+        hgrads = jax.tree.map(lambda a: jax.lax.psum(a, AXIS), hgrads)
+        loss_acc = jax.lax.psum(loss_acc, AXIS) / n_micro
+        aux_acc = jax.lax.psum(aux_acc, AXIS) / n_micro
+        d_src_acc = jax.lax.psum(d_src_acc, AXIS)
+        return grads, hgrads, loss_acc, aux_acc, d_src_acc
+
+    param_specs = jax.tree.map(lambda _: P(AXIS), stacked)
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, head_specs, P(), P()),
+        out_specs=(param_specs, head_specs, P(), P(), P()),
+        axis_names={AXIS}, check_vma=False)
+
+    saved_mesh = ctx.mesh if ctx is not None else None
+    if ctx is not None:
+        ctx.mesh = None
+    try:
+        grads, hgrads, loss, aux, d_src = fn(stacked, head_params, xm, tgt_mb)
+    finally:
+        if ctx is not None:
+            ctx.mesh = saved_mesh
+
+    # stage-stacked grads -> flat names (shared weights sum across blocks)
+    flat: typing.Dict[str, jax.Array] = {}
+    per_stage = len(fns) // n_stages
+    for s in range(n_stages):
+        for k_local in range(per_stage):
+            k = s * per_stage + k_local
+            names = tuple(plan[k][2])
+            for name, g in zip(names, grads[k_local]):
+                gs = g[s]
+                flat[name] = flat.get(name, 0) + gs
+    d_src_nt = nt(d_src.reshape(src.data.shape), src.dims)
+    return loss, aux, flat, hgrads, d_src_nt

@@ -67,6 +67,17 @@ class Trainer:
     def _grads(self, variables: Params, batch, rng):
         p = self.params
 
+        if (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1
+                and p.pipeline_schedule == "1f1b"
+                and p.multi_loss_strategy not in ("pcgrad", "mgda")
+                and p.use_language and not p.use_video
+                and not p.contrastive_across_samples
+                and not p.contrastive_across_token_embeddings):
+            # fused forward+backward schedule (loss head inside the last
+            # stage); computes grads itself rather than via jax.grad
+            return self.model.train_grads_1f1b(variables, batch, rng,
+                                               self.mesh)
+
         def loss_of(v, idx=None):
             info = self.model.apply(v, batch, rng, mesh=self.mesh)
             return (info.total_loss.data if idx is None
