@@ -1,0 +1,99 @@
+"""Checkpoint restore across mesh changes (VERDICT r1 weak #7).
+
+A checkpoint saved from an 8-device data x model mesh must restore bit-exact
+onto 4-device and 1-device layouts — the reference achieved topology
+portability by copying mesh slices to master values in its sharded Saver
+(/root/reference/src/run/run.py:160-175); here saves are host-side full
+arrays so any mesh can load them, and shard_params re-lays them out.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backend import make_params
+from homebrewnlp_tpu.core import sharding as shardlib
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer, TrainState, checkpoint as ckpt
+
+
+def _batch(params, rng):
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    return {"token_x": jnp.asarray(x),
+            "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+
+
+def _make(tmp_path, n_devices):
+    cfg = dict(heads=4, depth=2, train_batch_size=8, tpu_size=n_devices,
+               optimizer="adaptive_clip:0.003-sm3-momentum:0.9:1:1-learning_rate",
+               model_path=str(tmp_path))
+    params = make_params(**cfg)
+    model = Model(params)
+    mesh = shardlib.build_mesh(params, jax.devices()[:n_devices]) \
+        if n_devices > 1 else None
+    return params, model, Trainer(params, model, mesh=mesh)
+
+
+def mesh_change_restore_test(tmp_path):
+    rng = np.random.default_rng(0)
+    params, model, trainer = _make(tmp_path, 8)
+    batch = _batch(params, rng)
+    state = trainer.init_state(batch)
+    # a real step so optimizer slots hold non-trivial values
+    state, _ = trainer.step(state, batch, jax.random.PRNGKey(0))
+    ckpt.save(str(tmp_path), 1, state.variables, state.opt_state, max_keep=2)
+    want_vars = {k: np.asarray(v) for k, v in state.variables.items()}
+    want_opt = jax.tree_util.tree_map(np.asarray, state.opt_state)
+
+    for n_dev in (4, 1):
+        restored = ckpt.restore(str(tmp_path))
+        assert restored is not None
+        variables, opt_state, step, _ = restored
+        assert step == 1
+        p2, m2, tr2 = _make(tmp_path, n_dev)
+        tr2.init_state(_batch(p2, rng))  # establish model plan + optimizer
+        if tr2.mesh is not None:
+            variables = shardlib.shard_params(p2, variables, m2.param_dims,
+                                              tr2.mesh)
+        variables = {k: jnp.asarray(v) for k, v in variables.items()}
+        for k, want in want_vars.items():
+            got = np.asarray(variables[k])
+            np.testing.assert_array_equal(got, want, err_msg=f"{n_dev}d {k}")
+        got_opt = jax.tree_util.tree_map(np.asarray, opt_state)
+        jax.tree_util.tree_map(np.testing.assert_array_equal, got_opt,
+                               want_opt)
+        # restored state steps without error on the new mesh
+        st = TrainState(variables,
+                        jax.tree_util.tree_map(jnp.asarray, opt_state),
+                        jnp.asarray(step, jnp.int32))
+        st, metrics = tr2.step(st, _batch(p2, rng), jax.random.PRNGKey(1))
+        assert np.isfinite(float(metrics["loss"]))
+
+
+def mesh_change_same_trajectory_test(tmp_path):
+    """One further step from the restored checkpoint yields identical params
+    on the 8-device mesh and on a single device (f32 everywhere)."""
+    rng = np.random.default_rng(1)
+    params, model, trainer = _make(tmp_path, 8)
+    batch = _batch(params, rng)
+    state = trainer.init_state(batch)
+    state, _ = trainer.step(state, batch, jax.random.PRNGKey(0))
+    ckpt.save(str(tmp_path), 1, state.variables, state.opt_state)
+    batch2 = _batch(params, rng)
+
+    results = []
+    for n_dev in (8, 1):
+        variables, opt_state, step, _ = ckpt.restore(str(tmp_path))
+        p2, m2, tr2 = _make(tmp_path, n_dev)
+        tr2.init_state(_batch(p2, np.random.default_rng(9)))
+        if tr2.mesh is not None:
+            variables = shardlib.shard_params(p2, variables, m2.param_dims,
+                                              tr2.mesh)
+        st = TrainState({k: jnp.asarray(v) for k, v in variables.items()},
+                        jax.tree_util.tree_map(jnp.asarray, opt_state),
+                        jnp.asarray(step, jnp.int32))
+        st, _ = tr2.step(st, batch2, jax.random.PRNGKey(7))
+        results.append({k: np.asarray(v) for k, v in st.variables.items()})
+    for k in results[0]:
+        np.testing.assert_allclose(results[0][k], results[1][k], atol=1e-6,
+                                   err_msg=k)
