@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Summarise a jax.profiler trace into a per-op / per-category time table.
+
+Usage:
+    python scripts/analyze_trace.py <trace_dir_or_trace.json.gz> [--steps N]
+                                    [--top K]
+
+Works on the ``plugins/profile/<ts>/*.trace.json.gz`` files that
+``jax.profiler.start_trace`` writes (the train loop's ``profile_steps``
+option, run/train_loop.py).  The tensorboard profile plugin's converters are
+broken against this image's TF, and XLA dump flags don't reach the
+tunnel-side compiler — parsing the chrome-trace events by name is the
+methodology that produced the round-1/2 analyses in docs/PERFORMANCE.md
+(SURVEY.md §5.1: the reference had no op-level profiling at all).
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def load_events(path: str):
+    if os.path.isdir(path):
+        hits = sorted(glob.glob(os.path.join(
+            path, "**", "*.trace.json.gz"), recursive=True))
+        if not hits:
+            raise SystemExit(f"no *.trace.json.gz under {path}")
+        path = hits[-1]
+    with gzip.open(path) as f:
+        trace = json.load(f)
+    return [e for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e.get("dur")]
+
+
+def categorize(name: str) -> str:
+    if "dynamic-update-slice" in name or "dynamic_update" in name:
+        return "scan-stack (DUS)"
+    if "dynamic-slice" in name or "dynamic_slice" in name:
+        return "scan-unstack (DS)"
+    if "convert_reduce" in name or name.startswith("reduce"):
+        return "reduce"
+    if "add_add" in name or "select_add" in name or \
+            name.startswith(("add_", "select_")):
+        return "adds/elementwise"
+    if "convert_bitcast" in name or name.startswith(
+            ("convert", "bitcast", "copy", "transpose")):
+        return "convert/copy/transpose"
+    if name.startswith("fusion"):
+        return "fusion (dot-rooted)"
+    return "other: " + name.split(".")[0].split("(")[0][:32]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="trace dir or *.trace.json.gz")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="traced step count (per-step normalisation)")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    evs = load_events(args.trace)
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for e in evs:
+        agg[e["name"]] += e["dur"]
+        cnt[e["name"]] += 1
+
+    # wrapper/marker events, not device ops: python frames, pjit spans, and
+    # the bare per-step queue markers ("2"/"5"/"8" in these traces)
+    prefix_skip = ("jit_", "Pjit", "$", "np.", "while")
+    exact_skip = {"2", "5", "8"}
+
+    def keep(name: str) -> bool:
+        return (cnt[name] >= args.steps
+                and not name.startswith(prefix_skip)
+                and name not in exact_skip)
+
+    print(f"== top ops (us summed over trace; /{args.steps} steps) ==")
+    shown = 0
+    for name, dur in agg.most_common():
+        if not keep(name):
+            continue
+        print(f"{dur / 1e3 / args.steps:10.2f} ms/step  x{cnt[name]:6d}  "
+              f"{name[:90]}")
+        shown += 1
+        if shown >= args.top:
+            break
+
+    cats = collections.Counter()
+    for name, dur in agg.items():
+        if not keep(name):
+            continue
+        cats[categorize(name)] += dur
+    total = sum(cats.values())
+    print(f"\n== categories ({total / 1e3 / args.steps:.1f} ms/step "
+          f"categorized) ==")
+    for cat, dur in cats.most_common(15):
+        print(f"{dur / 1e3 / args.steps:10.2f} ms/step  "
+              f"{dur / total * 100:5.1f}%  {cat}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Jaxpr matmul-FLOP counter (homebrewnlp_tpu/utils/flops.py) — feeds the
+MFU number bench.py reports."""
+import jax
+import jax.numpy as jnp
+
+from backend import make_params  # noqa: F401  (sets up the CPU mesh env)
+
+
+def flops_counter_test():
+    """The jaxpr matmul-FLOP counter handles dots, scans (x length), and
+    batched dot_general."""
+    from homebrewnlp_tpu.utils.flops import forward_flops
+
+    a = jnp.zeros((8, 16))
+    b = jnp.zeros((16, 4))
+    assert forward_flops(lambda x, y: x @ y, a, b) == 2 * 8 * 16 * 4
+
+    bm = jnp.zeros((3, 8, 16))
+    wm = jnp.zeros((3, 16, 4))
+    assert forward_flops(lambda x, y: jnp.einsum("bij,bjk->bik", x, y),
+                         bm, wm) == 3 * 2 * 8 * 16 * 4
+
+    def scanned(x, y):
+        def body(c, _):
+            return c @ y, None
+        out, _ = jax.lax.scan(body, x, jnp.arange(5))
+        return out
+    sq = jnp.zeros((16, 16))
+    assert forward_flops(scanned, sq, sq) == 5 * 2 * 16 ** 3
