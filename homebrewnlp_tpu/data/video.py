@@ -226,10 +226,13 @@ def mixed_dataset(params: ModelParameter, sub_batch_size: int,
         if dtype == "video":
             streams.append(iter(VideoDataset(single, sub_batch_size,
                                              slice_index, slice_count, repeat)))
+            weights.append(float(cfg.get("weight", 1)))
         elif params.use_language:
+            # a weight only for configs that actually produce a stream, or
+            # the weighted choice desynchronizes from the stream list
             streams.append(iter(MixedTextDataset(single, sub_batch_size,
                                                  slice_index, slice_count, repeat)))
-        weights.append(float(cfg.get("weight", 1)))
+            weights.append(float(cfg.get("weight", 1)))
     total = sum(weights)
     weights = [w / total for w in weights]
     rng = np.random.default_rng(params.data_seed if seed is None else seed)

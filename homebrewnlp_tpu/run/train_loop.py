@@ -67,10 +67,25 @@ def make_dataset(params: ModelParameter, repeat: bool = True):
     if params.train_batch_size % nproc:
         raise ValueError(f"train_batch_size {params.train_batch_size} must "
                          f"divide evenly over {nproc} processes")
-    dataset = TextDataset(params, params.train_batch_size // nproc,
-                          slice_index=jax.process_index(),
-                          slice_count=nproc,
-                          runs_log=runs_log or None, repeat=repeat)
+    if params.use_video:
+        # jannet mode: weighted video/text mixing (reference dataset(),
+        # inputs.py:486-525) — frames + tokens + masks per batch.  Resume
+        # follows the reference's video semantics: skip the already-consumed
+        # sub-batches (dataset.skip(current_step), dataloader_placement.py:
+        # 155-156) instead of the text path's run-log replay
+        import itertools
+        from ..data.video import mixed_dataset
+        dataset: typing.Iterable = mixed_dataset(
+            params, params.train_batch_size // nproc,
+            slice_index=jax.process_index(), slice_count=nproc, repeat=repeat)
+        if params.current_step:
+            dataset = itertools.islice(
+                dataset, params.current_step * params.macro_batching, None)
+    else:
+        dataset = TextDataset(params, params.train_batch_size // nproc,
+                              slice_index=jax.process_index(),
+                              slice_count=nproc,
+                              runs_log=runs_log or None, repeat=repeat)
     return Prefetcher(_macro_batches(dataset, params.macro_batching),
                       depth=params.buffer_size)
 
