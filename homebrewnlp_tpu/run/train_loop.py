@@ -79,8 +79,9 @@ def make_dataset(params: ModelParameter, repeat: bool = True):
             params, params.train_batch_size // nproc,
             slice_index=jax.process_index(), slice_count=nproc, repeat=repeat)
         if params.current_step:
-            dataset = itertools.islice(
-                dataset, params.current_step * params.macro_batching, None)
+            # sub-batches consumed == step counter: each macro-group consumes
+            # macro_batching sub-batches AND advances the step by the same
+            dataset = itertools.islice(dataset, params.current_step, None)
     else:
         dataset = TextDataset(params, params.train_batch_size // nproc,
                               slice_index=jax.process_index(),
@@ -101,7 +102,13 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     mesh = shardlib.build_mesh(params) if len(devices) > 1 else None
     model = Model(params)
     trainer = Trainer(params, model, mesh=mesh)
-    _dump_run_config(params)
+    # host-side artifacts (run config, model_size.info, DataLog, metrics,
+    # checkpoints) are written by the chief only: on a multi-host pod every
+    # process runs this loop against one shared model_path (the reference
+    # wrote these to GCS the same way)
+    is_chief = jax.process_index() == 0
+    if is_chief:
+        _dump_run_config(params)
 
     restored = ckpt.restore(params.model_path) if params.use_checkpointing else None
     params.current_step = restored[2] if restored else ckpt.latest_step(params.model_path)
@@ -122,12 +129,13 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                            jnp.asarray(step, jnp.int32))
         print(f"restored checkpoint at step {step}")
 
-    analyze_model(params, {k: np.asarray(jax.device_get(v))
-                           for k, v in state.variables.items()},
-                  model.param_dims)
-    append_runs_log(params, 0, max(1, jax.process_count()))
+    if is_chief:
+        analyze_model(params, {k: np.asarray(jax.device_get(v))
+                               for k, v in state.variables.items()},
+                      model.param_dims)
+        append_runs_log(params, 0, max(1, jax.process_count()))
 
-    logger = MetricLogger(params.model_path)
+    logger = MetricLogger(params.model_path) if is_chief else None
     total_steps = train_steps if train_steps is not None else params.train_steps
     tokens_per_step = (params.train_batch_size * params.sequence_length
                        * params.macro_batching)
@@ -160,26 +168,28 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 break
             if step_now % log_every < params.macro_batching:
                 last_metrics = {k: float(v) for k, v in metrics.items()}
-                logger.log(step_now, metrics,
-                           tokens_per_step=params.train_batch_size * params.sequence_length)
-            if params.use_checkpointing and \
+                if logger is not None:
+                    logger.log(step_now, metrics,
+                               tokens_per_step=params.train_batch_size * params.sequence_length)
+            if is_chief and params.use_checkpointing and \
                     step_now % params.steps_per_checkpoint < params.macro_batching:
                 ckpt.save(params.model_path, step_now, state.variables,
                           state.opt_state, params.max_checkpoints_keep)
     finally:
         if profile_steps is not None and profiling:
             jax.profiler.stop_trace()
-        if params.use_checkpointing:
+        if is_chief and params.use_checkpointing:
             ckpt.save(params.model_path, int(state.step), state.variables,
                       state.opt_state, params.max_checkpoints_keep)
         # rewrite the run log entry with the steps actually consumed
-        log = read_runs_log(params)
+        log = read_runs_log(params) if is_chief else None
         if log:
             log[-1]["steps"] = steps_done
             with open(os.path.join(params.model_path, "DataLog.log"), "w") as f:
                 for entry in log:
                     f.write(json.dumps(entry) + "\n")
-        logger.close()
+        if logger is not None:
+            logger.close()
     wall = time.time() - t_start
     return {"steps": steps_done, "wall_s": wall,
             "final_step": int(state.step),

@@ -52,6 +52,16 @@ class Trainer:
                    seed: typing.Optional[int] = None) -> TrainState:
         one = {k: v[0] if self.params.macro_batching > 1 else v
                for k, v in batch.items()}
+        nproc = jax.process_count()
+        if nproc > 1:
+            # the caller feeds its per-process slice; the model traces (and
+            # the jit step sees) the assembled GLOBAL batch shape.  init is
+            # abstract (eval_shape) so only shape/dtype matter — np.empty
+            # avoids materialising a global-batch copy
+            one = {k: np.empty((np.asarray(v).shape[0] * nproc,)
+                               + np.asarray(v).shape[1:],
+                               np.asarray(v).dtype)
+                   for k, v in one.items()}
         variables = self.model.init(one, seed)
         self.optimizer = Optimizer(self.params, self.model.param_dims)
         if self.mesh is not None:

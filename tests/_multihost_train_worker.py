@@ -1,0 +1,34 @@
+"""Worker for the 2-process full-train-loop test.
+
+Run as: python _multihost_train_worker.py <port> <pid> <nproc> <cfg.json>
+with JAX_PLATFORMS=cpu and 4 virtual devices per process.  Runs the REAL
+``run.train_loop.train`` over the 8-device multi-controller mesh: each
+process loads its own dataset slice, shard_batch assembles the global batch,
+and only the chief writes metrics/checkpoints.  Prints the final loss so the
+parent can assert both processes computed the same trajectory.
+"""
+import json
+import sys
+
+
+def main() -> int:
+    port, pid, nproc, cfg_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                  int(sys.argv[3]), sys.argv[4])
+    import jax
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
+                               process_id=pid)
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.run.train_loop import train
+
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    params = ModelParameter(cfg)
+    result = train(params)
+    print(f"WORKER {pid} FINAL {result['final_loss']:.6f} "
+          f"steps {result['final_step']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

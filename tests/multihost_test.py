@@ -23,28 +23,39 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def two_process_assembly_test():
+
+def _spawn_workers(worker: str, extra_args, env_devcount: int = 4,
+                   n_procs: int = 2, timeout: int = 420):
+    """Launch n multi-controller worker processes on a shared coordinator
+    port with a virtual CPU mesh; returns [(proc, output), ...]."""
     port = _free_port()
     env = dict(os.environ)
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                    env.get("XLA_FLAGS", ""))
     env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
-               XLA_FLAGS=flags + " --xla_force_host_platform_device_count=4")
-    worker = os.path.join(HERE, "_multihost_worker.py")
-    procs = [subprocess.Popen([sys.executable, worker, str(port), str(pid), "2"],
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-             for pid in range(2)]
-    outs = []
+               XLA_FLAGS=flags +
+               f" --xla_force_host_platform_device_count={env_devcount}")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), str(pid), str(n_procs)]
+        + [str(a) for a in extra_args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(n_procs)]
+    results = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
+        results.append((p, out))
+    return results
+
+
+def two_process_assembly_test():
+    results = _spawn_workers(os.path.join(HERE, "_multihost_worker.py"), [],
+                             timeout=300)
+    for pid, (p, out) in enumerate(results):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"worker {pid}: OK" in out, out
 
@@ -68,3 +79,61 @@ def single_process_macro_axis_test():
     out = shardlib.shard_batch(params, batch, mesh)["token_x"]
     spec = out.sharding.spec
     assert len(spec) >= 2 and spec[0] is None and spec[1] == "data", spec
+
+
+def two_process_train_loop_test(tmp_path):
+    """The REAL train loop over two jax processes: per-process dataset
+    slices, global-batch assembly, chief-only artifact writes, identical
+    loss trajectory on both controllers."""
+    import json
+
+    from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example
+
+    data_dir = tmp_path / "data"
+    os.makedirs(data_dir)
+    rng = np.random.default_rng(0)
+    for i in range(4):  # >= 2 files per process slice
+        base = np.tile(np.arange(32, dtype=np.uint8), 4096 // 32)
+        noise = rng.integers(0, 32, 4096).astype(np.uint8)
+        tokens = np.where(rng.random(4096) < 0.05, noise, base)
+        with RecordWriter(str(data_dir / f"p_{i}_4096.tfrecord")) as w:
+            w.write(encode_example({"text": tokens.tobytes()}))
+
+    cfg = {
+        "model_mode": "gpt", "use_video": False, "use_language": True,
+        "sequence_length": 32, "features_per_head": 16, "heads": 2,
+        "depth": 2, "train_batch_size": 8, "vocab_size": 32,
+        "calc_accuracy": False, "memory_reduction_strategy": "revnet",
+        "block_config": [{"layer": ["norm-shift-scale-features-group",
+                                    "feed_forward-in:relu"]}],
+        "group_linear_factor": 2, "tpu_size": 8,
+        "mesh_shape_override": {"data": 8},
+        "optimizer": "adam-learning_rate", "learning_rate": 0.003,
+        "weight_decay": 0.0,
+        "learning_rate_config": {"linear_warmup": {"final_step": 8}},
+        "train_steps": 12, "interleaved_datasets": 2,
+        "use_checkpointing": True, "steps_per_checkpoint": 10,
+        "data_seed": 7,
+        "dataset_configs": [{"path": str(data_dir / "*"), "type": "text",
+                             "weight": 1}],
+        "model_path": str(tmp_path / "run"),
+    }
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    results = _spawn_workers(os.path.join(HERE, "_multihost_train_worker.py"),
+                             [cfg_path])
+    finals = []
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        line = [l for l in out.splitlines() if l.startswith(f"WORKER {pid}")]
+        assert line, out
+        finals.append(float(line[0].split("FINAL")[1].split()[0]))
+    # both controllers ran the same global computation
+    assert finals[0] == finals[1], finals
+    # chief-only artifacts: one metrics file, checkpoints exist, and no
+    # duplicate-writer corruption in the jsonl
+    run_dir = tmp_path / "run"
+    metrics = [json.loads(l) for l in open(run_dir / "metrics.jsonl")]
+    assert metrics and all(np.isfinite(m["loss"]) for m in metrics)
+    assert any(d.startswith("ckpt_") for d in os.listdir(run_dir))
