@@ -12,9 +12,11 @@ sequence sharding — new capability, reference has none, SURVEY.md §5.7).
 """
 from __future__ import annotations
 
+import functools
 import typing
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -89,6 +91,52 @@ def shard_params(params: ModelParameter, variables: typing.Dict[str, jax.Array],
     return out
 
 
+@functools.lru_cache(maxsize=8)
+def process_data_slice(mesh: Mesh) -> typing.Tuple[int, int]:
+    """(slice_index, slice_count) of the global batch this process must feed.
+
+    The 'data' mesh axis may span fewer process groups than there are
+    processes (e.g. full model parallelism: data=1, model across hosts —
+    every process must then feed IDENTICAL full batches), or more than one
+    row-block per process.  Derived from which data-axis coordinates this
+    process's devices actually occupy; cached per mesh (called every step
+    from shard_batch — the device scan is O(all devices))."""
+    if "data" not in mesh.axis_names:
+        return 0, 1
+    axis = mesh.axis_names.index("data")
+    pid = jax.process_index()
+    coords = sorted({idx[axis] for idx, dev in np.ndenumerate(mesh.devices)
+                     if dev.process_index == pid})
+    if not coords:
+        return 0, 1
+    data_size = mesh.shape["data"]
+    span = len(coords)
+    assert coords == list(range(coords[0], coords[0] + span)), \
+        f"non-contiguous data coords for process {pid}: {coords}"
+    # unaligned layouts would let two processes claim the same slice while
+    # another goes unfed — refuse instead of silently training on wrong data
+    assert coords[0] % span == 0 and data_size % span == 0, \
+        f"process {pid} data coords {coords} not block-aligned in {data_size}"
+    slice_count = max(1, data_size // span)
+    return coords[0] // span, slice_count
+
+
+def place_tree(template_tree, host_tree):
+    """Lay host (numpy) arrays out with the shardings of a template tree of
+    live jax Arrays.  Works in multi-controller runs where a plain
+    ``device_put`` cannot target non-addressable devices: every process holds
+    the full host value and contributes the shards it owns
+    (``make_array_from_callback``)."""
+    def place(template, host):
+        host = np.asarray(host)
+        if not isinstance(template, jax.Array):
+            return jnp.asarray(host)
+        assert template.shape == host.shape, (template.shape, host.shape)
+        return jax.make_array_from_callback(
+            host.shape, template.sharding, lambda idx: host[idx])
+    return jax.tree_util.tree_map(place, template_tree, host_tree)
+
+
 def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
                 mesh: Mesh) -> typing.Dict[str, jax.Array]:
     """Batch arrays shard along their leading (batch) axis over 'data'.
@@ -105,6 +153,11 @@ def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
     """
     out = {}
     nproc = jax.process_count()
+    # the number of distinct batch slices across processes follows the
+    # data-axis process layout, NOT the process count: with full model
+    # parallelism (data axis inside each host group) every process feeds
+    # identical full batches
+    _, slice_count = process_data_slice(mesh) if nproc > 1 else (0, 1)
     # under macro-batching the leading axis is the macro index; the batch
     # axis (the one sharded over 'data' and split across processes) is 1
     batch_axis = 1 if params.macro_batching > 1 else 0
@@ -113,7 +166,7 @@ def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
         global_shape = list(value.shape)
         if "data" in mesh.axis_names and value.ndim > batch_axis:
             if nproc > 1:
-                global_shape[batch_axis] *= nproc
+                global_shape[batch_axis] *= slice_count
             if global_shape[batch_axis] % mesh.shape["data"] == 0:
                 entries[batch_axis] = "data"
             elif nproc > 1:

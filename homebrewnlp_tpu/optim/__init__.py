@@ -19,6 +19,7 @@ import typing
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import ModelParameter
 from ..core.dims import Dim
@@ -59,6 +60,23 @@ def parse_chain(optimizer: str) -> typing.List[typing.Tuple[str, typing.Tuple[st
     return chain
 
 
+def _zeros_for(variable, shape, dtype):
+    """Zero slot laid out like its variable: same-shape slots inherit the
+    variable's sharding, reduced-shape slots (SM3 per-dim buckets, scalars)
+    replicate over the same mesh.  A bare ``jnp.zeros`` would commit to the
+    process-local default device — mixed with globally-sharded variables in
+    one jit, a multi-controller run rejects that ('incompatible devices')."""
+    if isinstance(variable, jax.Array) and isinstance(
+            variable.sharding, jax.sharding.NamedSharding):
+        mesh = variable.sharding.mesh
+        sharding = variable.sharding if tuple(shape) == tuple(variable.shape) \
+            else jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        host = np.zeros(shape, dtype)
+        return jax.make_array_from_callback(tuple(shape), sharding,
+                                            lambda idx: host[idx])
+    return jnp.zeros(shape, dtype)
+
+
 class Optimizer:
     def __init__(self, params: ModelParameter,
                  param_dims: typing.Dict[str, tuple]):
@@ -89,7 +107,8 @@ class Optimizer:
                     ctx.grad = OPTIMIZERS[opt_name](ctx, *args)
                 return ctx.new_slots
             slots = jax.eval_shape(_shapes)
-            state[name] = {k: jnp.zeros(v.shape, opt_dtype) for k, v in slots.items()}
+            state[name] = {k: _zeros_for(value, v.shape, opt_dtype)
+                           for k, v in slots.items()}
         return state
 
     def update(self, variables: Params, grads: Params, state: OptState,

@@ -59,14 +59,21 @@ def _macro_batches(dataset, macro: int):
             yield {k: np.stack([g[k] for g in group]) for k in group[0]}
 
 
-def make_dataset(params: ModelParameter, repeat: bool = True):
+def make_dataset(params: ModelParameter, repeat: bool = True, mesh=None):
     runs_log = read_runs_log(params)
     # each process loads only its slice of the global batch; shard_batch
-    # assembles the slices via make_array_from_process_local_data
+    # assembles the slices via make_array_from_process_local_data.  The
+    # slice layout follows the data-axis process groups (full model
+    # parallelism replicates identical batches per group), not the raw
+    # process count.
     nproc = max(1, jax.process_count())
-    if params.train_batch_size % nproc:
+    if mesh is not None and nproc > 1:
+        slice_index, slice_count = shardlib.process_data_slice(mesh)
+    else:
+        slice_index, slice_count = jax.process_index(), nproc
+    if params.train_batch_size % slice_count:
         raise ValueError(f"train_batch_size {params.train_batch_size} must "
-                         f"divide evenly over {nproc} processes")
+                         f"divide evenly over {slice_count} batch slices")
     if params.use_video:
         # jannet mode: weighted video/text mixing (reference dataset(),
         # inputs.py:486-525) — frames + tokens + masks per batch.  Resume
@@ -76,16 +83,16 @@ def make_dataset(params: ModelParameter, repeat: bool = True):
         import itertools
         from ..data.video import mixed_dataset
         dataset: typing.Iterable = mixed_dataset(
-            params, params.train_batch_size // nproc,
-            slice_index=jax.process_index(), slice_count=nproc, repeat=repeat)
+            params, params.train_batch_size // slice_count,
+            slice_index=slice_index, slice_count=slice_count, repeat=repeat)
         if params.current_step:
             # sub-batches consumed == step counter: each macro-group consumes
             # macro_batching sub-batches AND advances the step by the same
             dataset = itertools.islice(dataset, params.current_step, None)
     else:
-        dataset = TextDataset(params, params.train_batch_size // nproc,
-                              slice_index=jax.process_index(),
-                              slice_count=nproc,
+        dataset = TextDataset(params, params.train_batch_size // slice_count,
+                              slice_index=slice_index,
+                              slice_count=slice_count,
                               runs_log=runs_log or None, repeat=repeat)
     return Prefetcher(_macro_batches(dataset, params.macro_batching),
                       depth=params.buffer_size)
@@ -113,26 +120,28 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     restored = ckpt.restore(params.model_path) if params.use_checkpointing else None
     params.current_step = restored[2] if restored else ckpt.latest_step(params.model_path)
 
-    data = make_dataset(params)
+    data = make_dataset(params, mesh=mesh)
     first_batch = next(iter(data))
     state = trainer.init_state(first_batch)
     if restored:
         variables, opt_state, step, _ = restored
         variables = {k: np.asarray(v).astype(state.variables[k].dtype)
                      for k, v in variables.items()}
-        if mesh is not None:
-            variables = shardlib.shard_params(params, variables,
-                                              model.param_dims, mesh)
         from ..train import TrainState
-        state = TrainState({k: jnp.asarray(v) for k, v in variables.items()},
-                           jax.tree_util.tree_map(jnp.asarray, opt_state),
-                           jnp.asarray(step, jnp.int32))
+        # the freshly-initialised state is the sharding template: place_tree
+        # lays every restored host array out identically (including
+        # optimizer slots, and including cross-process shardings where a
+        # bare device_put cannot reach non-addressable devices)
+        state = TrainState(
+            shardlib.place_tree(state.variables, variables),
+            shardlib.place_tree(state.opt_state, opt_state),
+            jnp.asarray(step, jnp.int32))
         print(f"restored checkpoint at step {step}")
 
     if is_chief:
-        analyze_model(params, {k: np.asarray(jax.device_get(v))
-                               for k, v in state.variables.items()},
-                      model.param_dims)
+        # analyze_model reads shapes only — no device_get (which would also
+        # fail on non-fully-addressable arrays in multi-host model sharding)
+        analyze_model(params, state.variables, model.param_dims)
         append_runs_log(params, 0, max(1, jax.process_count()))
 
     logger = MetricLogger(params.model_path) if is_chief else None
@@ -171,14 +180,17 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 if logger is not None:
                     logger.log(step_now, metrics,
                                tokens_per_step=params.train_batch_size * params.sequence_length)
-            if is_chief and params.use_checkpointing and \
+            # every process participates in a distributed save (the save
+            # itself barriers and assigns writer roles); single-process
+            # saves are chief-trivially
+            if params.use_checkpointing and \
                     step_now % params.steps_per_checkpoint < params.macro_batching:
                 ckpt.save(params.model_path, step_now, state.variables,
                           state.opt_state, params.max_checkpoints_keep)
     finally:
         if profile_steps is not None and profiling:
             jax.profiler.stop_trace()
-        if is_chief and params.use_checkpointing:
+        if params.use_checkpointing:
             ckpt.save(params.model_path, int(state.step), state.variables,
                       state.opt_state, params.max_checkpoints_keep)
         # rewrite the run log entry with the steps actually consumed

@@ -52,13 +52,14 @@ class Trainer:
                    seed: typing.Optional[int] = None) -> TrainState:
         one = {k: v[0] if self.params.macro_batching > 1 else v
                for k, v in batch.items()}
-        nproc = jax.process_count()
-        if nproc > 1:
+        if jax.process_count() > 1 and self.mesh is not None:
             # the caller feeds its per-process slice; the model traces (and
-            # the jit step sees) the assembled GLOBAL batch shape.  init is
-            # abstract (eval_shape) so only shape/dtype matter — np.empty
-            # avoids materialising a global-batch copy
-            one = {k: np.empty((np.asarray(v).shape[0] * nproc,)
+            # the jit step sees) the assembled GLOBAL batch shape (local x
+            # the number of distinct data-axis slices).  init is abstract
+            # (eval_shape) so only shape/dtype matter — np.empty avoids
+            # materialising a global-batch copy
+            _, slice_count = shardlib.process_data_slice(self.mesh)
+            one = {k: np.empty((np.asarray(v).shape[0] * slice_count,)
                                + np.asarray(v).shape[1:],
                                np.asarray(v).dtype)
                    for k, v in one.items()}

@@ -78,9 +78,31 @@ def _set_leaf(tree: dict, key: str, value):
     cur[parts[-1]] = value
 
 
+def _is_distributed(value) -> bool:
+    return isinstance(value, jax.Array) and not value.is_fully_addressable
+
+
+def _slice_spec(index: typing.Tuple[slice, ...], shape) -> list:
+    return [[0 if s.start is None else int(s.start),
+             dim if s.stop is None else int(s.stop)]
+            for s, dim in zip(index, shape)]
+
+
 def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
          opt_state: typing.Dict[str, typing.Dict[str, jax.Array]],
          max_keep: int = 1, extra: typing.Optional[dict] = None) -> str:
+    """Write a checkpoint.  Single process: one file per full array.
+    Multi-host: call from EVERY process — arrays whose shards span processes
+    (e.g. model-axis sharding across hosts) are written shard-wise by the
+    process that owns each shard (replica 0 only, so replicated copies write
+    once), with per-process shard manifests the chief's ``index.json`` links
+    together; everything else is written by the chief.  The directory rename
+    is barriered so the checkpoint only becomes visible when all processes
+    have flushed their shards."""
+    nproc = jax.process_count()
+    if nproc > 1:
+        return _save_distributed(model_path, step, variables, opt_state,
+                                 max_keep, extra)
     ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
     tmp_dir = ckpt_dir + ".tmp"
     os.makedirs(tmp_dir, exist_ok=True)
@@ -129,9 +151,91 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
     return ckpt_dir
 
 
+def multihost_utils_sync(tag: str) -> None:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def _save_distributed(model_path: str, step: int, variables, opt_state,
+                      max_keep: int, extra: typing.Optional[dict]) -> str:
+    pid = jax.process_index()
+    ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
+    tmp_dir = ckpt_dir + ".tmp"
+    # a crashed earlier save (possibly from a run with MORE processes) may
+    # have left stale shard files in the tmp dir; restore() reads every
+    # shards_*.json, so stale files would corrupt the reassembly — clear
+    # before anyone writes, then barrier
+    if pid == 0 and os.path.exists(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    multihost_utils_sync(f"ckpt_clear_{step}")
+    os.makedirs(tmp_dir, exist_ok=True)
+    tree = {"variables": variables, "opt_state": opt_state}
+    leaves = list(_leaf_files(tree))
+
+    chief_arrays: typing.Dict[str, dict] = {}
+    shard_entries: typing.List[dict] = []
+    chief_fetch = []
+    shard_meta = []
+    shard_data_refs = []
+    for i, (key, value) in enumerate(leaves):
+        if _is_distributed(value):
+            for j, shard in enumerate(value.addressable_shards):
+                if shard.replica_id != 0:
+                    continue  # replicated copy: some process already owns it
+                shard_meta.append((i, key, j, shard.index, value))
+                shard_data_refs.append(shard.data)
+        elif pid == 0:
+            chief_fetch.append((i, key, value))
+    # one batched D2H for all owned shards (per-shard np.asarray would pay a
+    # serialized round trip each — the same trap the single-process save
+    # chunks around)
+    fetched_shards = jax.device_get(shard_data_refs)
+    for (i, key, j, index, value), host in zip(shard_meta, fetched_shards):
+        fname = f"arr_{i:06d}_p{pid}_s{j}.bin"
+        with open(os.path.join(tmp_dir, fname), "wb") as f:
+            np.asarray(host).tofile(f)
+        shard_entries.append({
+            "key": key, "file": fname,
+            "index": _slice_spec(index, value.shape),
+            "global_shape": list(value.shape),
+            "dtype": _dtype_name(value.dtype)})
+    if pid == 0:
+        fetched = jax.device_get([v for _, _, v in chief_fetch])
+        for (i, key, _), value in zip(chief_fetch, fetched):
+            host = np.asarray(value)
+            fname = f"arr_{i:06d}.bin"
+            with open(os.path.join(tmp_dir, fname), "wb") as f:
+                host.tofile(f)
+            chief_arrays[key] = {"file": fname, "shape": list(host.shape),
+                                 "dtype": _dtype_name(host.dtype)}
+    with open(os.path.join(tmp_dir, f"shards_{pid}.json"), "w") as f:
+        json.dump({"process_index": pid, "shards": shard_entries}, f)
+    if pid == 0:
+        with open(os.path.join(tmp_dir, "index.json"), "w") as f:
+            json.dump({"step": int(step), "distributed": True,
+                       "process_count": jax.process_count(),
+                       "arrays": chief_arrays, "extra": extra or {}}, f)
+    # every process must have flushed before the directory becomes visible
+    multihost_utils_sync(f"ckpt_save_{step}")
+    if pid == 0:
+        if os.path.exists(ckpt_dir):
+            shutil.rmtree(ckpt_dir)
+        os.replace(tmp_dir, ckpt_dir)
+        if max_keep > 0:
+            for old in list_checkpoints(model_path)[:-max_keep]:
+                shutil.rmtree(os.path.join(model_path, f"ckpt_{old}"),
+                              ignore_errors=True)
+    multihost_utils_sync(f"ckpt_done_{step}")
+    return ckpt_dir
+
+
 def restore(model_path: str, step: typing.Optional[int] = None
             ) -> typing.Optional[typing.Tuple[dict, dict, int, dict]]:
-    """-> (variables, opt_state, step, extra) or None if no checkpoint."""
+    """-> (variables, opt_state, step, extra) or None if no checkpoint.
+
+    Distributed checkpoints reassemble full host arrays from the per-process
+    shard files (every process reads every shard — shard_params re-lays them
+    out afterwards)."""
     if step is None:
         steps = list_checkpoints(model_path)
         if not steps:
@@ -147,5 +251,24 @@ def restore(model_path: str, step: typing.Optional[int] = None
         arr = np.frombuffer(raw, dtype=_np_dtype(meta["dtype"]))
         arr = arr.reshape(meta["shape"]).copy()
         _set_leaf(tree, key, arr)
+    if manifest.get("distributed"):
+        assembled: typing.Dict[str, np.ndarray] = {}
+        import glob as _glob
+        for mpath in sorted(_glob.glob(os.path.join(ckpt_dir, "shards_*.json"))):
+            with open(mpath) as f:
+                shard_manifest = json.load(f)
+            for entry in shard_manifest["shards"]:
+                key = entry["key"]
+                if key not in assembled:
+                    assembled[key] = np.empty(entry["global_shape"],
+                                              _np_dtype(entry["dtype"]))
+                with open(os.path.join(ckpt_dir, entry["file"]), "rb") as f:
+                    raw = f.read()
+                idx = tuple(slice(lo, hi) for lo, hi in entry["index"])
+                part = np.frombuffer(raw, dtype=_np_dtype(entry["dtype"]))
+                assembled[key][idx] = part.reshape(
+                    [hi - lo for lo, hi in entry["index"]])
+        for key, arr in assembled.items():
+            _set_leaf(tree, key, arr)
     return (tree["variables"], tree.get("opt_state", {}),
             int(manifest["step"]), manifest.get("extra", {}))

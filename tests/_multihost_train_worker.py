@@ -24,9 +24,58 @@ def main() -> int:
     with open(cfg_path) as f:
         cfg = json.load(f)
     params = ModelParameter(cfg)
-    result = train(params)
+    result = train(params, log_every=2)
     print(f"WORKER {pid} FINAL {result['final_loss']:.6f} "
           f"steps {result['final_step']}")
+
+    if cfg.get("mesh_shape_override", {}).get("model", 1) > 1:
+        # model axis spans both processes: verify the distributed checkpoint
+        # reassembles to the live (allgathered) parameter values
+        import numpy as np
+        from jax.experimental import multihost_utils
+        from homebrewnlp_tpu.core import sharding as shardlib
+        from homebrewnlp_tpu.model import Model
+        from homebrewnlp_tpu.train import Trainer, checkpoint as ckpt
+        from homebrewnlp_tpu.run.train_loop import make_dataset
+
+        # barrier: the chief rewrites DataLog in train()'s finally block;
+        # without the sync the other process may read the stale log and
+        # build a different dataset slice
+        multihost_utils.sync_global_devices("post_train_phase")
+        params2 = ModelParameter(cfg)
+        params2.current_step = 0
+        mesh = shardlib.build_mesh(params2)
+        model = Model(params2)
+        trainer = Trainer(params2, model, mesh=mesh)
+        batch = next(iter(make_dataset(params2, mesh=mesh)))
+        state = trainer.init_state(batch)
+        sharded = [k for k, v in state.variables.items()
+                   if not v.is_fully_addressable]
+        assert sharded, "expected model-sharded params to span processes"
+        ckpt.save(cfg["model_path"] + "_dist", 7, state.variables,
+                  state.opt_state)
+        restored = ckpt.restore(cfg["model_path"] + "_dist")
+        assert restored is not None and restored[2] == 7
+        for k, v in state.variables.items():
+            want = np.asarray(multihost_utils.process_allgather(
+                v, tiled=True))
+            got = np.asarray(restored[0][k])
+            assert got.shape == want.shape, (k, got.shape, want.shape)
+            assert np.array_equal(got, want), k
+        print(f"WORKER {pid} DISTCKPT OK ({len(sharded)} spanning arrays)")
+
+        # resume-into-train: place the restored host arrays back onto the
+        # cross-process shardings (the train loop's restore path) and step
+        import jax.numpy as jnp
+        from homebrewnlp_tpu.train import TrainState
+        st = TrainState(
+            shardlib.place_tree(state.variables, restored[0]),
+            shardlib.place_tree(state.opt_state, restored[1]),
+            jnp.asarray(restored[2], jnp.int32))
+        st, metrics = trainer.step(st, batch)
+        loss = float(metrics["loss"])
+        assert np.isfinite(loss), loss
+        print(f"WORKER {pid} DISTRESUME OK {loss:.6f}")
     return 0
 
 
