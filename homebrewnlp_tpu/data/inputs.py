@@ -11,10 +11,11 @@ Reference: /root/reference/src/inputs.py.  Same structure, no tf.data:
 - windowed token stream per record: window size ctx+patch, shift ctx
   (inputs.py:247-249); byte records vs int64 records chosen by the
   ``'int64' in filename`` convention (inputs.py:350,553).
-- round-robin interleave over ``interleaved_datasets`` files, weighted
-  mixing across dataset configs, background prefetch (the reference
-  serialized infeed after compute, run.py:251-256 — prefetch here overlaps
-  host decode with device steps).
+- static-group round-robin interleave over ``interleaved_datasets`` files
+  (the same model the resume simulator replays, making resume bit-exact —
+  see ``simulate_data_pipeline``), weighted mixing across dataset configs,
+  background prefetch (the reference serialized infeed after compute,
+  run.py:251-256 — prefetch here overlaps host decode with device steps).
 """
 from __future__ import annotations
 
@@ -33,89 +34,187 @@ from .tfrecord import decode_example, read_records
 
 
 def split_files(filenames: typing.List[str], slice_index: int, slice_count: int,
-                seed: int, runs_log=None):
+                seed: int, runs_log=None, interleave: int = None):
+    """Deterministic per-slice file shard with resume state.
+
+    Returns ``(files, token_skips, phase, repeat_files)`` for this slice.
+    ``phase`` is the round-robin position inside the first interleave group
+    at which the resumed stream must continue; it is non-zero only when
+    ``runs_log`` is given, the log's last run used the same
+    ``(slice_count, interleave)``, and that run was cut mid-group.
+    ``repeat_files`` is the slice's FULL file list: repeat passes (epoch 2+)
+    of the stream iterate it — resuming must not drop already-consumed files
+    from later epochs.  Pass all of it to ``_InterleavedStream``.
+    """
     if not filenames:
         raise ValueError("no input files")
     files = sorted(filenames)
     if seed != 0:
         rng = random.Random(seed)
         rng.shuffle(files)
+    all_slice = files[slice_index::slice_count]
 
     element_skip = [0] * len(files)
+    phase = 0
     if runs_log:
-        file_list_skip, element_skip = simulate_data_pipeline(runs_log, files)
+        file_list_skip, element_skip, resume = simulate_data_pipeline(runs_log, files)
         files = [files[i] for i, s in enumerate(file_list_skip) if not s]
         element_skip = [element_skip[i] for i, s in enumerate(file_list_skip) if not s]
-    return files[slice_index::slice_count], element_skip[slice_index::slice_count]
+        if (resume["slice_count"] == slice_count
+                and (interleave is None or resume["interleave"] == interleave)):
+            phase = resume["phases"][slice_index]
+    return (files[slice_index::slice_count],
+            element_skip[slice_index::slice_count], phase, all_slice)
 
 
 def _tokens_in_name(path: str) -> int:
     return int(str(path).split('_')[-1].replace('.tfrecord', ''))
 
 
+def _usable_tokens(count: int, ctx: int, tps: int) -> int:
+    """Tokens of ``count`` that produce windows: ``windows * ctx`` where
+    windows = number of (ctx+tps)-sized, ctx-shifted windows in ``count``."""
+    return max(count - ((count - tps) % ctx) - tps, 0)
+
+
 def simulate_data_pipeline(runs_log, file_list):
-    """Replay of the run log -> (full-file skip flags, per-file token skips).
-    Port of the arithmetic in reference inputs.py:33-128."""
+    """Replay the run log -> exact resume state for the interleaved stream.
+
+    Returns ``(file_list_skip, element_skip, resume)``:
+
+    * ``file_list_skip[i]`` — drop file ``i`` entirely (it belongs to a fully
+      consumed interleave group).  Fully consumed files inside a PARTIALLY
+      consumed group are kept (with a full-token skip) so that group
+      membership — and therefore the round-robin order — is identical on
+      resume.
+    * ``element_skip[i]`` — tokens already consumed from the start of file
+      ``i``; ``_file_windows`` skips them before windowing.
+    * ``resume`` — ``{"phases": [per-slice next-draw index within the first
+      surviving group], "slice_count": ..., "interleave": ...}`` describing
+      the state after the log's LAST run (only valid for a new run with the
+      same slice/interleave geometry; ``split_files`` checks).
+
+    Invariants (tested in tests/data_test.py::resume_continuation_*):
+
+    * For ``slice_count == 1`` the resumed stream continues BIT-EXACTLY with
+      the windows an uninterrupted stream would yield next, for ANY cut
+      point — including mid-interleave-group cuts and cuts after the stream
+      wrapped past the end of the dataset (``repeat=True``).
+    * For ``slice_count > 1`` the same holds per slice as long as group
+      consumption is symmetric across slices (equal file sizes); otherwise
+      re-slicing after dropped groups can reassign files between slices and
+      only the global no-window-lost/no-window-duplicated multiset property
+      holds (same as the reference, /root/reference/src/inputs.py:33-128).
+    * With multiple weighted datasets, per-dataset consumption is estimated
+      as if all windows came from that dataset (reference behaviour);
+      resume is exact only for single-text-dataset configs.
+
+    The executed pipeline (``_InterleavedStream``) uses STATIC interleave
+    groups — round-robin within a group of ``interleave_size`` files, moving
+    to the next group only when the current one is exhausted — precisely the
+    model replayed here, so the arithmetic is exact for unequal file sizes
+    too (tf.data's dynamic slot-replacement interleave, which the reference
+    used, diverges from the reference's own replay arithmetic in that case).
+    """
     counts = [_tokens_in_name(f) for f in file_list]
-    file_list_skip = [False] * len(counts)
-    element_skip = [0] * len(counts)
-    file_idx_list = list(range(len(counts)))
+    n = len(counts)
+    file_list_skip = [False] * n
+    element_skip = [0] * n
+    phases: typing.List[int] = [0]
+    prev_key = None
+    slice_count = interleave_size = 1
 
     for run in runs_log:
-        _counts = [counts[i] for i, s in enumerate(file_list_skip) if not s]
-        _element_skip = [element_skip[i] for i, s in enumerate(file_list_skip) if not s]
-        _file_idx = [file_idx_list[i] for i, s in enumerate(file_list_skip) if not s]
-        _counts = [c - s for c, s in zip(_counts, _element_skip)]
-
         slice_count = run['slice_count']
         ctx = run['ctx']
-        step_stop_count = run['steps'] * run['grad_accumulation'] * (run['batch_size'] // slice_count)
         interleave_size = run['interleave_size']
-        token_patch_size = run['token_patch_size']
+        tps = run['token_patch_size']
+        stop0 = run['steps'] * run['grad_accumulation'] * (run['batch_size'] // slice_count)
 
-        for slice_index in range(slice_count):
-            _counts_slice = _counts[slice_index::slice_count]
-            _idx_slice = _file_idx[slice_index::slice_count]
-            _stop = step_stop_count
+        live = [i for i in range(n) if not file_list_skip[i]]
+        key = (slice_count, interleave_size)
+        carry = phases if prev_key == key and len(phases) == slice_count \
+            else [0] * slice_count
+        phases = []
+        final_lists = []
+        for s in range(slice_count):
+            phase, final_idx = _replay_slice(
+                live[s::slice_count], list(range(s, n, slice_count)), counts,
+                element_skip, file_list_skip, ctx, tps, interleave_size,
+                stop0, carry[s])
+            phases.append(phase)
+            final_lists.append(final_idx)
+        prev_key = key
 
-            for inter_start in range(0, len(_counts_slice), interleave_size):
-                chunk = [c - ((c - token_patch_size) % ctx) - token_patch_size
-                         for c in _counts_slice[inter_start:inter_start + interleave_size]]
-                orig_chunk = chunk.copy()
-                total_windows = sum(chunk) // ctx
-                if total_windows > _stop:
-                    i = 0
-                    while sum(chunk) > 0 and _stop > 0:
-                        while chunk[i] <= 0:
-                            i = (i + 1) % len(chunk)
-                        chunk[i] -= ctx
-                        _stop -= 1
-                        i = (i + 1) % len(chunk)
-                    removed = [o - c for o, c in zip(orig_chunk, chunk)]
-                    for c_i in range(len(chunk)):
-                        file_idx = _idx_slice[inter_start + c_i]
-                        if chunk[c_i] <= 0:
-                            file_list_skip[file_idx] = True
-                        element_skip[file_idx] += removed[c_i]
-                    if _stop <= 0:
-                        break
-                else:
-                    _stop -= total_windows
-                    for c_i in range(len(chunk)):
-                        file_idx = _idx_slice[inter_start + c_i]
-                        file_list_skip[file_idx] = True
-                        element_skip[file_idx] = orig_chunk[c_i]
+        # Keep fully-consumed files inside partially-consumed groups so that
+        # group membership is preserved on resume; drop whole groups only.
+        # The groups of the run's FINAL pass (the live list for pass 1, the
+        # full slice list after a wrap) define membership.
+        for idx in final_lists:
+            for gs in range(0, len(idx), interleave_size):
+                grp = idx[gs:gs + interleave_size]
+                full = all(file_list_skip[i] for i in grp)
+                for i in grp:
+                    file_list_skip[i] = full
 
-        for slice_index in range(slice_count):
-            skip_slice = file_list_skip[slice_index::slice_count]
-            idx_slice = file_idx_list[slice_index::slice_count]
-            for inter_start in range(0, len(skip_slice), interleave_size):
-                group = skip_slice[inter_start:inter_start + interleave_size]
-                full = sum(group) == len(group)
-                for idx in idx_slice[inter_start:inter_start + interleave_size]:
-                    file_list_skip[idx] = full
+    return file_list_skip, element_skip, {
+        "phases": phases, "slice_count": slice_count,
+        "interleave": interleave_size}
 
-    return file_list_skip, element_skip
+
+def _replay_slice(live_idx, all_idx, counts, element_skip, file_list_skip,
+                  ctx, tps, interleave, stop, phase):
+    """Replay one slice's stream for one run, mutating ``element_skip`` /
+    ``file_list_skip``.  Pass 1 runs over ``live_idx`` (the resumed view);
+    repeat passes reopen the slice's FULL list ``all_idx`` with no skips —
+    already-consumed files come back in later epochs.  Returns ``(phase,
+    final_idx)``: the round-robin position inside the group the run was cut
+    in (0 on a group boundary) and the file list whose groups formed the
+    final pass."""
+    first_pass = True
+    while True:
+        idx = live_idx if first_pass else all_idx
+        rem = [_usable_tokens(counts[i] - element_skip[i], ctx, tps) if first_pass
+               else _usable_tokens(counts[i], ctx, tps) for i in idx]
+        if not first_pass:
+            # Wrapped past the end: the stream reopens the full slice list
+            # with no skips.  Clear the slice's consumption and fast-forward
+            # whole passes.
+            total = sum(rem) // ctx
+            if total == 0:
+                return 0, idx
+            for i in idx:
+                element_skip[i] = 0
+                file_list_skip[i] = False
+            stop %= total
+        for gs in range(0, len(idx), interleave):
+            grp = list(range(gs, min(gs + interleave, len(idx))))
+            total = sum(rem[g] for g in grp) // ctx
+            start = phase if first_pass and gs == 0 else 0
+            phase = 0
+            if stop >= total:
+                stop -= total
+                for g in grp:
+                    element_skip[idx[g]] += rem[g]
+                    file_list_skip[idx[g]] = True
+                if stop == 0:
+                    return 0, idx
+            else:
+                i = min(start, len(grp) - 1)
+                while stop > 0:
+                    while rem[grp[i]] <= 0:
+                        i = (i + 1) % len(grp)
+                    rem[grp[i]] -= ctx
+                    element_skip[idx[grp[i]]] += ctx
+                    stop -= 1
+                    i = (i + 1) % len(grp)
+                for g in grp:
+                    if rem[g] <= 0:
+                        file_list_skip[idx[g]] = True
+                return i, idx
+        if stop <= 0:
+            return 0, idx
+        first_pass = False
 
 
 # ---- token extraction ----------------------------------------------------
@@ -154,44 +253,56 @@ def _file_windows(path: str, ctx: int, patch: int, skip_tokens: int,
 
 
 class _InterleavedStream:
-    """Round-robin over up to ``cycle`` concurrently-open files
-    (tf.data interleave(cycle_length=N, block_length=1) semantics)."""
+    """Round-robin over STATIC groups of ``cycle`` files: files are processed
+    in consecutive groups of ``cycle``; windows are drawn round-robin within
+    the group (exhausted members are dropped from the rotation) and the next
+    group opens only once the current one is fully drained.
 
-    def __init__(self, files, skips, ctx, patch, cycle, int_tokens, repeat):
+    This is exactly the model ``simulate_data_pipeline`` replays, which makes
+    deterministic resume exact for any file sizes.  ``phase`` is the resume
+    round-robin position inside the FIRST group (from ``split_files``);
+    ``skips`` apply to the first pass only — on ``repeat`` the stream reopens
+    ``repeat_files`` (the slice's full, unfiltered file list — consumed files
+    dropped from the resume pass come back in later epochs) with no skips.
+    """
+
+    def __init__(self, files, skips, ctx, patch, cycle, int_tokens, repeat,
+                 phase: int = 0, repeat_files=None):
         self.files = list(files)
         self.skips = list(skips) if skips else [0] * len(self.files)
         self.ctx = ctx
         self.patch = patch
-        self.cycle = max(1, min(cycle, len(self.files)))
+        self.cycle = max(1, cycle)
         self.int_tokens = int_tokens
         self.repeat = repeat
+        self.phase = phase
+        self.repeat_files = list(repeat_files) if repeat_files is not None \
+            else list(files)
 
     def __iter__(self):
-        next_file = 0
-        n_files = len(self.files)
-        active: typing.List[typing.Iterator[np.ndarray]] = []
-
-        def open_next(idx):
-            return _file_windows(self.files[idx % n_files], self.ctx, self.patch,
-                                 self.skips[idx % n_files] if idx < n_files else 0,
-                                 self.int_tokens)
-
-        while next_file < self.cycle:
-            active.append(open_next(next_file))
-            next_file += 1
-        i = 0
-        while active:
-            try:
-                yield next(active[i])
-                i = (i + 1) % len(active)
-            except StopIteration:
-                if next_file < n_files or self.repeat:
-                    active[i] = open_next(next_file)
-                    next_file += 1
-                else:
-                    del active[i]
-                    if active:
-                        i %= len(active)
+        first_pass = True
+        while True:
+            files = self.files if first_pass else self.repeat_files
+            skips = self.skips if first_pass else None
+            n = len(files)
+            for start in range(0, n, self.cycle):
+                group = [
+                    _file_windows(files[j], self.ctx, self.patch,
+                                  skips[j] if skips else 0, self.int_tokens)
+                    for j in range(start, min(start + self.cycle, n))]
+                i = min(self.phase, len(group) - 1) if first_pass and start == 0 \
+                    else 0
+                while group:
+                    try:
+                        yield next(group[i])
+                        i = (i + 1) % len(group)
+                    except StopIteration:
+                        del group[i]
+                        if group:
+                            i %= len(group)
+            if not self.repeat or not self.repeat_files:
+                return
+            first_pass = False
 
 
 def _expand_glob(path: str) -> typing.List[str]:
@@ -220,14 +331,16 @@ class TextDataset:
             filenames = []
             for pattern in ([cfg['path']] if isinstance(cfg['path'], str) else cfg['path']):
                 filenames.extend(_expand_glob(pattern))
-            files, skips = split_files(
+            files, skips, phase, all_files = split_files(
                 filenames, slice_index, slice_count,
-                params.data_seed * int(params.shuffle_input_filenames), runs_log)
-            int_tokens = bool(files) and 'int64' in files[0]
+                params.data_seed * int(params.shuffle_input_filenames), runs_log,
+                interleave=params.interleaved_datasets)
+            int_tokens = bool(all_files) and 'int64' in all_files[0]
             patch = params.token_patch_size * params.output_offset
             streams.append(_InterleavedStream(files, skips, params.sequence_length,
                                               patch, params.interleaved_datasets,
-                                              int_tokens, repeat))
+                                              int_tokens, repeat, phase=phase,
+                                              repeat_files=all_files))
             weights.append(float(cfg.get('weight', 1)))
         if not streams:
             raise ValueError("no text dataset configs")

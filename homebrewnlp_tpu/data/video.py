@@ -98,8 +98,8 @@ class VideoDataset:
                 for pattern in ([cfg["path"]] if isinstance(cfg["path"], str)
                                 else cfg["path"]):
                     filenames.extend(_expand_glob(pattern))
-        self.files, _ = split_files(filenames, slice_index, slice_count,
-                                    params.data_seed * int(params.shuffle_input_filenames))
+        self.files, _, _, _ = split_files(filenames, slice_index, slice_count,
+                                          params.data_seed * int(params.shuffle_input_filenames))
 
     def _file_windows(self, path):
         p = self.params
@@ -170,8 +170,8 @@ class MixedTextDataset:
                 for pattern in ([cfg["path"]] if isinstance(cfg["path"], str)
                                 else cfg["path"]):
                     filenames.extend(_expand_glob(pattern))
-        files, skips = split_files(filenames, slice_index, slice_count,
-                                   params.data_seed * int(params.shuffle_input_filenames))
+        files, skips, _, _ = split_files(filenames, slice_index, slice_count,
+                                         params.data_seed * int(params.shuffle_input_filenames))
         int_tokens = bool(files) and "int64" in files[0]
         ltpf = params.language_token_per_frame
         ctx = params.time_patch_size * (ltpf - 1)

@@ -59,18 +59,22 @@ def _macro_batches(dataset, macro: int):
             yield {k: np.stack([g[k] for g in group]) for k in group[0]}
 
 
+def data_slice_geometry(mesh=None):
+    """The (slice_index, slice_count) the dataset actually feeds with: the
+    data-axis process groups (full model parallelism replicates identical
+    batches per group), not the raw process count.  The run log must record
+    THIS slice_count — the resume replay is keyed on it."""
+    nproc = max(1, jax.process_count())
+    if mesh is not None and nproc > 1:
+        return shardlib.process_data_slice(mesh)
+    return jax.process_index(), nproc
+
+
 def make_dataset(params: ModelParameter, repeat: bool = True, mesh=None):
     runs_log = read_runs_log(params)
     # each process loads only its slice of the global batch; shard_batch
-    # assembles the slices via make_array_from_process_local_data.  The
-    # slice layout follows the data-axis process groups (full model
-    # parallelism replicates identical batches per group), not the raw
-    # process count.
-    nproc = max(1, jax.process_count())
-    if mesh is not None and nproc > 1:
-        slice_index, slice_count = shardlib.process_data_slice(mesh)
-    else:
-        slice_index, slice_count = jax.process_index(), nproc
+    # assembles the slices via make_array_from_process_local_data
+    slice_index, slice_count = data_slice_geometry(mesh)
     if params.train_batch_size % slice_count:
         raise ValueError(f"train_batch_size {params.train_batch_size} must "
                          f"divide evenly over {slice_count} batch slices")
@@ -142,7 +146,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # analyze_model reads shapes only — no device_get (which would also
         # fail on non-fully-addressable arrays in multi-host model sharding)
         analyze_model(params, state.variables, model.param_dims)
-        append_runs_log(params, 0, max(1, jax.process_count()))
+        append_runs_log(params, 0, data_slice_geometry(mesh)[1])
 
     logger = MetricLogger(params.model_path) if is_chief else None
     total_steps = train_steps if train_steps is not None else params.train_steps

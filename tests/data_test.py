@@ -117,12 +117,12 @@ def window_semantics_test(tmp_path):
 
 def split_files_test():
     files = [f"f_{i}_100.tfrecord" for i in range(10)]
-    a, _ = split_files(files, 0, 2, seed=0)
-    b, _ = split_files(files, 1, 2, seed=0)
+    a, _, _, _ = split_files(files, 0, 2, seed=0)
+    b, _, _, _ = split_files(files, 1, 2, seed=0)
     assert sorted(a + b) == sorted(files)
     assert not (set(a) & set(b))
-    s1, _ = split_files(files, 0, 2, seed=123)
-    s2, _ = split_files(files, 0, 2, seed=123)
+    s1, _, _, _ = split_files(files, 0, 2, seed=123)
+    s2, _, _, _ = split_files(files, 0, 2, seed=123)
     assert s1 == s2  # deterministic shuffle
 
 
@@ -134,11 +134,12 @@ def simulate_resume_test():
     run = {"steps": 3, "grad_accumulation": 1, "batch_size": 1,
            "slice_count": 1, "ctx": ctx, "interleave_size": 2,
            "token_patch_size": patch}
-    skip_flags, skips = simulate_data_pipeline([run], files)
-    # 3 windows consumed round-robin from files 0,1: two from f0? order:
-    # f0,f1,f0 -> f0 skipped 16 tokens, f1 skipped 8
+    skip_flags, skips, resume = simulate_data_pipeline([run], files)
+    # 3 windows consumed round-robin from files 0,1: order f0,f1,f0 ->
+    # f0 skipped 16 tokens, f1 skipped 8; next draw is f1 (phase 1)
     assert skips[0] == 16 and skips[1] == 8
     assert not any(skip_flags)
+    assert resume["phases"] == [1]
 
 
 def text_dataset_batches_test(tmp_path):
@@ -180,57 +181,166 @@ def dataset_determinism_test(tmp_path):
     np.testing.assert_array_equal(take(3), take(3))
 
 
+def _make_record_dir(tmp_path, name, sizes, seed=3):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / name
+    os.makedirs(d)
+    for i, sz in enumerate(sizes):
+        payload = bytes(rng.integers(0, 256, sz).astype(np.uint8).tolist())
+        _write_byte_file(str(d / f"p_{i}_{sz}.tfrecord"), [payload])
+    return d
+
+
+def _take(it, n):
+    out = []
+    for i, b in enumerate(it):
+        out.append(b["token_x"])
+        if i + 1 >= n:
+            break
+    return out
+
+
+def _log_entry(ctx, interleave, batch, k, tps=1, slice_count=1):
+    return {"steps": k, "ctx": ctx, "slice_count": slice_count,
+            "interleave_size": interleave, "batch_size": batch,
+            "grad_accumulation": 1, "token_patch_size": tps}
+
+
+def _check_exact_resume(data_dir, ctx, interleave, batch, ks, tps=1,
+                        repeat=False, horizon=3):
+    """Assert: resuming after k batches continues BIT-EXACTLY with the
+    batches an uninterrupted stream yields after its first k."""
+    params = make_params(sequence_length=ctx, train_batch_size=batch,
+                         interleaved_datasets=interleave,
+                         token_patch_size=tps,
+                         dataset_configs=[{"path": str(data_dir / "*"),
+                                           "type": "text", "weight": 1}])
+    for k in ks:
+        full = _take(iter(TextDataset(params, batch, repeat=repeat)),
+                     k + horizon)
+        log = [_log_entry(ctx, interleave, batch, k, tps)]
+        resumed = _take(iter(TextDataset(params, batch, runs_log=log,
+                                         repeat=repeat)), horizon)
+        tag = f"dir={data_dir.name} ctx={ctx} il={interleave} b={batch} " \
+              f"k={k} tps={tps} repeat={repeat}"
+        want = full[k:]
+        assert len(resumed) == len(want), \
+            f"{tag}: resumed {len(resumed)} batches, want {len(want)}"
+        for j, (w, got) in enumerate(zip(want, resumed)):
+            np.testing.assert_array_equal(got, w, err_msg=f"{tag} step={j}")
+
+
 def resume_continuation_property_test(tmp_path):
-    """The load-bearing resume invariants (reference inputs.py:33-128):
-
-    * when the consumed count lands on an interleave-cycle boundary (or
-      interleave is 1) the resumed stream continues with EXACTLY the batches
-      an uninterrupted stream yields after its first k;
-    * otherwise the per-file skips are still exact — no window is repeated
-      or lost — but the round-robin phase restarts, so the continuation is
-      a rotation: compare as window multisets over the overlap horizon
-      (matching the reference's own semantics)."""
+    """THE load-bearing resume invariant (simulate_data_pipeline docstring):
+    for slice_count==1 the resumed stream continues bit-exactly for ANY cut
+    point — mid-interleave-group cuts included — because the executed stream
+    uses static interleave groups and the resume state carries the
+    round-robin phase."""
     import itertools
+    equal = _make_record_dir(tmp_path, "equal", [2048] * 4)
+    for ctx, interleave, batch in itertools.product((8, 16), (1, 2), (1, 2)):
+        _check_exact_resume(equal, ctx, interleave, batch, ks=(1, 2, 3))
 
-    rng = np.random.default_rng(3)
-    data_dir = tmp_path / "data"
-    os.makedirs(data_dir)
-    n_files = 4
-    for i in range(n_files):
-        payload = bytes(rng.integers(0, 256, 2048).astype(np.uint8).tolist())
-        _write_byte_file(str(data_dir / f"p_{i}_2048.tfrecord"), [payload])
 
-    def windows(batches):
-        return [bytes(row.tobytes()) for b in batches for row in b]
+def resume_ragged_files_test(tmp_path):
+    """Unequal file sizes: files exhaust mid-group, so the round robin runs
+    with dead members — resume must still be bit-exact (this is where the
+    reference's replay arithmetic and tf.data's dynamic interleave diverge;
+    our static-group stream matches the replay exactly)."""
+    ragged = _make_record_dir(tmp_path, "ragged", [330, 97, 512, 200, 64])
+    for interleave in (2, 3):
+        _check_exact_resume(ragged, 8, interleave, 1, ks=range(1, 8))
+        _check_exact_resume(ragged, 8, interleave, 2, ks=range(1, 5))
 
-    for ctx, interleave, batch, k in itertools.product(
-            (8, 16), (1, 2), (1, 2), (1, 2, 3)):
-        params = make_params(
-            sequence_length=ctx, train_batch_size=batch,
-            interleaved_datasets=interleave,
-            dataset_configs=[{"path": str(data_dir / "*"), "type": "text",
-                              "weight": 1}])
-        horizon = 3
-        full = []
-        for i, b in enumerate(TextDataset(params, batch, repeat=False)):
-            full.append(b["token_x"])
-            if i + 1 >= k + horizon:
-                break
-        log_entry = {"steps": k, "ctx": ctx, "slice_count": 1,
-                     "interleave_size": interleave, "batch_size": batch,
-                     "grad_accumulation": 1, "token_patch_size": 1}
-        resumed = []
-        for i, b in enumerate(TextDataset(params, batch, runs_log=[log_entry],
-                                          repeat=False)):
-            resumed.append(b["token_x"])
-            if i + 1 >= horizon:
-                break
-        tag = f"ctx={ctx} il={interleave} b={batch} k={k}"
-        if interleave == 1 or (k * batch) % interleave == 0:
-            for j, (want, got) in enumerate(zip(full[k:], resumed)):
-                np.testing.assert_array_equal(got, want,
-                                              err_msg=f"{tag} step={j}")
-        else:
-            want = sorted(windows(full[k:]))
-            got = sorted(windows(resumed))
-            assert got == want, f"{tag}: window multiset diverged on resume"
+
+def resume_token_patch_test(tmp_path):
+    """token_patch_size > 1 changes the window arithmetic (window =
+    ctx + tps, shift ctx); resume stays exact."""
+    d = _make_record_dir(tmp_path, "tps", [400, 250, 333])
+    _check_exact_resume(d, 16, 2, 1, ks=(1, 2, 3, 4), tps=2)
+
+
+def resume_wrap_test(tmp_path):
+    """Cuts after the stream wrapped past the end of the dataset
+    (repeat=True): the replay fast-forwards whole passes and resumes inside
+    the current pass."""
+    d = _make_record_dir(tmp_path, "wrap", [40, 40])
+    # 4 windows per file per pass (ctx 8, window 9) -> 8 windows per pass
+    _check_exact_resume(d, 8, 2, 1, ks=(7, 8, 9, 10, 17, 23), repeat=True)
+    _check_exact_resume(d, 8, 1, 1, ks=(8, 13), repeat=True)
+
+
+def resume_repeat_restores_dropped_groups_test(tmp_path):
+    """A cut that fully consumed an interleave GROUP must not drop that
+    group from later epochs: pass 2+ reopens the full file list.  Long
+    horizons drive the resumed stream across the wrap boundary."""
+    d = _make_record_dir(tmp_path, "wrapgroups", [40, 40, 40, 40])
+    # groups [f0,f1],[f2,f3]; 16 windows per pass
+    _check_exact_resume(d, 8, 2, 1, ks=(9, 12, 16, 21), repeat=True,
+                        horizon=20)
+    ragged = _make_record_dir(tmp_path, "wrapragged", [330, 97, 512, 200, 64])
+    _check_exact_resume(ragged, 8, 2, 1, ks=(30, 55, 80, 130), repeat=True,
+                        horizon=40)
+
+
+def resume_after_exact_exhaustion_test(tmp_path):
+    """A logged run that STARTS after an earlier run exactly exhausted the
+    dataset replays against the wrapped (full) list — its consumption must
+    not be discarded."""
+    d = _make_record_dir(tmp_path, "exact", [40, 40])  # 8 windows per pass
+    params = make_params(sequence_length=8, train_batch_size=1,
+                         interleaved_datasets=2,
+                         dataset_configs=[{"path": str(d / "*"),
+                                           "type": "text", "weight": 1}])
+    for k1, k2 in ((8, 2), (8, 8), (16, 3), (8, 11)):
+        full = _take(iter(TextDataset(params, 1, repeat=True)), k1 + k2 + 4)
+        log = [_log_entry(8, 2, 1, k1), _log_entry(8, 2, 1, k2)]
+        resumed = _take(iter(TextDataset(params, 1, runs_log=log,
+                                         repeat=True)), 4)
+        for j, (w, got) in enumerate(zip(full[k1 + k2:], resumed)):
+            np.testing.assert_array_equal(
+                got, w, err_msg=f"k1={k1} k2={k2} step={j}")
+
+
+def resume_multi_run_test(tmp_path):
+    """Two successive resumes (two log entries): the replay carries the
+    round-robin phase across runs."""
+    d = _make_record_dir(tmp_path, "multi", [330, 97, 512, 200, 64])
+    params = make_params(sequence_length=8, train_batch_size=1,
+                         interleaved_datasets=2,
+                         dataset_configs=[{"path": str(d / "*"),
+                                           "type": "text", "weight": 1}])
+    for k1, k2 in ((1, 1), (1, 2), (3, 2), (2, 5)):
+        full = _take(iter(TextDataset(params, 1, repeat=False)), k1 + k2 + 3)
+        log = [_log_entry(8, 2, 1, k1), _log_entry(8, 2, 1, k2)]
+        resumed = _take(iter(TextDataset(params, 1, runs_log=log,
+                                         repeat=False)), 3)
+        want = full[k1 + k2:]
+        assert len(resumed) == len(want), f"k1={k1} k2={k2}"
+        for j, (w, got) in enumerate(zip(want, resumed)):
+            np.testing.assert_array_equal(got, w,
+                                          err_msg=f"k1={k1} k2={k2} step={j}")
+
+
+def resume_sliced_test(tmp_path):
+    """slice_count=2 with equal file sizes: per-slice resume is bit-exact
+    (group consumption is symmetric across slices; the per-slice phase is
+    carried)."""
+    d = _make_record_dir(tmp_path, "sliced", [257] * 8)
+    params = make_params(sequence_length=8, train_batch_size=4,
+                         interleaved_datasets=2,
+                         dataset_configs=[{"path": str(d / "*"),
+                                           "type": "text", "weight": 1}])
+    for k in (1, 2, 3, 5):
+        for s in (0, 1):
+            full = _take(iter(TextDataset(params, 2, slice_index=s,
+                                          slice_count=2, repeat=False)), k + 3)
+            log = [_log_entry(8, 2, 4, k, slice_count=2)]
+            resumed = _take(iter(TextDataset(params, 2, slice_index=s,
+                                             slice_count=2, runs_log=log,
+                                             repeat=False)), 3)
+            want = full[k:]
+            assert len(resumed) == len(want), f"k={k} slice={s}"
+            for j, (w, got) in enumerate(zip(want, resumed)):
+                np.testing.assert_array_equal(
+                    got, w, err_msg=f"k={k} slice={s} step={j}")
