@@ -111,12 +111,14 @@ def process_data_slice(mesh: Mesh) -> typing.Tuple[int, int]:
         return 0, 1
     data_size = mesh.shape["data"]
     span = len(coords)
-    assert coords == list(range(coords[0], coords[0] + span)), \
-        f"non-contiguous data coords for process {pid}: {coords}"
+    if coords != list(range(coords[0], coords[0] + span)):
+        raise ValueError(
+            f"non-contiguous data coords for process {pid}: {coords}")
     # unaligned layouts would let two processes claim the same slice while
     # another goes unfed — refuse instead of silently training on wrong data
-    assert coords[0] % span == 0 and data_size % span == 0, \
-        f"process {pid} data coords {coords} not block-aligned in {data_size}"
+    if coords[0] % span or data_size % span:
+        raise ValueError(f"process {pid} data coords {coords} not "
+                         f"block-aligned in data axis of size {data_size}")
     slice_count = max(1, data_size // span)
     return coords[0] // span, slice_count
 

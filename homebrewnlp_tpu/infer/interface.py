@@ -61,7 +61,8 @@ class InterfaceWrapper:
         end = seq if response_len is None else min(seq, prompt_len + response_len)
         out = sample_text(self.model, self.variables, tokens[None, :prompt_len],
                           initial_pos=prompt_len, temperature=temperature,
-                          end_iterations=end, seed=seed)
+                          end_iterations=end, seed=seed,
+                          pad_random=True)  # reference interface.py:263
         return out[0, :end, 0] if out.ndim == 3 else out[0, :end]
 
     def complete(self, query: str, temperature: float = 0.0,

@@ -1,14 +1,24 @@
-"""FastAPI serving mode (reference: /root/reference/src/rest_api.py).
+"""REST serving mode (reference: /root/reference/src/rest_api.py).
 
 Endpoints: /completion, /token_completion, /encode, /decode, mirroring the
 reference's RestAPI surface (:74-89).  fastapi/uvicorn are optional — when
 absent (as in this image) a dependency-free fallback HTTP server provides the
 same JSON endpoints so web_api mode always works.
+
+Process isolation (default): the HTTP server runs in a daemon SUBPROCESS and
+talks to the device loop through Manager-dict/queue IPC, the reference's
+uvicorn-subprocess + Manager-dict design (rest_api.py:84-87,
+interface.py:231-280) — HTTP parsing and slow clients never block the device
+loop, and completions are strictly serialized onto the device from one
+process.  ``isolate=False`` keeps everything in-process (handy for tests and
+notebook use).
 """
 from __future__ import annotations
 
 import json
+import time
 import typing
+import uuid
 
 from ..config import ModelParameter
 from .interface import InterfaceWrapper
@@ -44,17 +54,18 @@ def _handlers(interface: InterfaceWrapper):
             "/encode": encode, "/decode": decode}
 
 
-def serve(params: ModelParameter, interface: InterfaceWrapper,
-          workers: int = 1, port: int = DEFAULT_PORT):
-    handlers = _handlers(interface)
+def _run_http(port: int, paths: typing.List[str],
+              dispatch: typing.Callable[[str, dict], dict], workers: int = 1):
+    """Serve the endpoint set over HTTP, blocking.  ``dispatch(path, body)``
+    produces the JSON response (directly, or via IPC to the device loop)."""
     try:
         import fastapi
         import uvicorn
         app = fastapi.FastAPI()
-        for path, fn in handlers.items():
-            def make_endpoint(f=fn):
+        for path in paths:
+            def make_endpoint(p=path):
                 async def endpoint(body: dict):
-                    return f(body)
+                    return dispatch(p, body)
                 return endpoint
             app.post(path)(make_endpoint())
         uvicorn.run(app, host="0.0.0.0", port=port, workers=workers)
@@ -62,20 +73,18 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     except ImportError:
         pass
 
-    # stdlib fallback with the same endpoints
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
-            fn = handlers.get(self.path)
-            if fn is None:
+            if self.path not in paths:
                 self.send_response(404)
                 self.end_headers()
                 return
             length = int(self.headers.get("Content-Length", 0))
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")
-                result = fn(body)
+                result = dispatch(self.path, body)
                 payload = json.dumps(result).encode()
                 self.send_response(200)
             except Exception as e:  # surface errors as JSON
@@ -89,5 +98,66 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
         def log_message(self, *a):
             pass
 
-    print(f"serving on :{port} (stdlib fallback; install fastapi+uvicorn for ASGI)")
     ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()
+
+
+def _http_child(port: int, paths: typing.List[str], requests, responses,
+                workers: int, deadline_s: float = 600.0):
+    """Subprocess body: HTTP in, Manager IPC to the device loop out."""
+    def dispatch(path: str, body: dict) -> dict:
+        rid = uuid.uuid4().hex
+        requests.put((rid, path, body))
+        t0 = time.time()
+        while rid not in responses:
+            if time.time() - t0 > deadline_s:
+                raise RuntimeError("device loop did not answer within "
+                                   f"{deadline_s}s")
+            time.sleep(0.002)
+        out = responses.pop(rid)
+        if isinstance(out, dict) and "_error" in out:
+            raise RuntimeError(out["_error"])
+        return out
+
+    _run_http(port, paths, dispatch, workers)
+
+
+def serve(params: ModelParameter, interface: InterfaceWrapper,
+          workers: int = 1, port: int = DEFAULT_PORT, isolate: bool = True):
+    handlers = _handlers(interface)
+    if not isolate:
+        print(f"serving on :{port} (in-process)")
+        return _run_http(port, list(handlers),
+                         lambda p, b: handlers[p](b), workers)
+
+    import multiprocessing as mp
+    import queue as queue_mod
+    # spawn, not fork: the parent's JAX/TPU runtime is multithreaded by now
+    # and forking it can deadlock the child even though the child never
+    # touches JAX.  _http_child's args are all picklable.
+    ctx = mp.get_context("spawn")
+    manager = ctx.Manager()
+    requests = manager.Queue()
+    responses = manager.dict()
+    proc = ctx.Process(target=_http_child,
+                       args=(port, list(handlers), requests, responses,
+                             workers),
+                       daemon=True)
+    proc.start()
+    print(f"serving on :{port} (HTTP subprocess pid {proc.pid}; device loop "
+          f"in main process)")
+    # the device loop: strictly serialized completions in the process that
+    # owns the model.  Poll with a timeout so a dead HTTP child (e.g. the
+    # port was already bound) surfaces instead of blocking forever.
+    while True:
+        try:
+            rid, path, body = requests.get(timeout=1.0)
+        except queue_mod.Empty:
+            if not proc.is_alive():
+                raise RuntimeError(
+                    f"HTTP subprocess exited (code {proc.exitcode}); "
+                    "is the port already in use?")
+            continue
+        try:
+            responses[rid] = handlers[path](body)
+        except Exception as e:
+            responses[rid] = {"_error": str(e)}

@@ -85,7 +85,13 @@ def init_decode_caches(model: Model, variables, token_x) -> dict:
         out_shapes = jax.eval_shape(
             lambda v, t, c: model.apply_decode(v, t, jnp.int32(0), c)[1],
             variables, tok0, stacked)
-    except Exception:
+    except (TypeError, ValueError, KeyError) as e:
+        # structural mismatch only — anything else is a real model bug and
+        # must surface.  The flat fallback restacks per token (slow); warn so
+        # the perf regression is observable.
+        import warnings
+        warnings.warn(f"stacked decode-cache probe failed ({e!r}); "
+                      "falling back to the flat (slower) cache layout")
         return flat
     same_structure = (set(out_shapes) == set(stacked)
                       and all(out_shapes[k].shape == tuple(stacked[k].shape)
@@ -151,9 +157,14 @@ def make_kv_sampler(model: Model) -> typing.Callable:
 
 def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
                 temperature=None, end_iterations=None, seed: int = 0,
-                use_cache: bool = True):
+                use_cache: bool = True, pad_random: bool = False):
     """Convenience host-level entry (pads/crops the prompt to sequence
-    length); prompt_tokens: int array [batch, <=seq] or [batch, seq, patch]."""
+    length); prompt_tokens: int array [batch, <=seq] or [batch, seq, patch].
+
+    ``pad_random`` fills the region beyond the prompt with uniform random
+    tokens instead of zeros (reference interface.py:263); with causal
+    attention the generated stream is identical either way — it is parity
+    surface for the interactive modes."""
     import numpy as np
     params = model.params
     seq = params.sequence_length // params.token_patch_size
@@ -162,7 +173,11 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
     if prompt.ndim == 2:
         prompt = prompt[:, :, None]
     batch = prompt.shape[0]
-    token_x = np.zeros((batch, seq, tps), np.int32)
+    if pad_random:
+        token_x = np.random.default_rng(seed).integers(
+            0, params.vocab_size, (batch, seq, tps)).astype(np.int32)
+    else:
+        token_x = np.zeros((batch, seq, tps), np.int32)
     n = min(seq, prompt.shape[1])
     token_x[:, :n] = prompt[:, :n]
     if initial_pos is None:

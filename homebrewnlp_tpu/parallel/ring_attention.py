@@ -8,11 +8,22 @@ full [seq, seq] score matrix never materialises and per-device memory is
 O(seq/P · d + blockwise scratch).  Communication overlaps compute: XLA
 schedules the ppermute of step j+1 against the matmuls of step j.
 
+Training memory is O(seq/P · d) too: ``_ring_core`` is a ``custom_vjp``
+whose forward saves only (q, k, v, out, lse) — the flash-attention residual
+set — instead of letting autodiff store the per-hop [sq, sq] probability
+tensors for all P hops (O(seq²/P) per layer, which made the 32k
+sequence-parallel target untrainable).  The backward runs the ring again:
+(k, v, dk, dv) rotate together, each hop recomputes its probability block
+from the saved log-sum-exp CHUNKED over query rows (a lax.scan, transient
+O(block_q · sq) like parallel/flash_attention.py's chunked backward), adds
+the visiting block's dk/dv contribution, and after P hops every (dk, dv)
+block has completed the full ring and is back on its home device.
+
 Causality across shards: after j rotation steps the local device q-shard
 ``i`` holds the k/v block originally from shard ``(i - j) mod P``; blocks
 from a strictly earlier shard attend fully, the diagonal block uses the
-triangular mask, later blocks contribute nothing (their contribution is
-multiplied to -inf, keeping every device in lock-step for the collective).
+triangular mask, later blocks contribute nothing (their scores are masked
+to -1e30, keeping every device in lock-step for the collective).
 """
 from __future__ import annotations
 
@@ -21,65 +32,182 @@ import typing
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, mask, m_prev, l_prev, acc):
-    """One online-softmax accumulation step.
-    q: [b, sq, h, d], k/v: [b, sk, h, d], mask: [sq, sk] additive."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores + mask[None, None, :, :]
-    m_new = jnp.maximum(m_prev, scores.max(-1))            # [b, h, q]
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(scores - m_new[..., None])                  # [b, h, q, k]
-    l_new = l_prev * alpha + p.sum(-1)
-    acc = acc * alpha[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32)
-    return m_new, l_new, acc
+def _pick_block(sq: int, want: int) -> int:
+    """Largest divisor of sq that is <= want."""
+    bq = min(want, sq)
+    while sq % bq:
+        bq -= 1
+    return bq
 
 
-def _ring_body(axis_name: str, n_shards: int, causal: bool, scale: float,
-               q, k, v):
+def _chunk(x, nc):
+    """[b, h, sq, ...] -> [nc, b, h, bq, ...] (scan leading axis)."""
+    b, h, sq = x.shape[:3]
+    return jnp.moveaxis(x.reshape(b, h, nc, sq // nc, *x.shape[3:]), 2, 0)
+
+
+def _unchunk(x):
+    """[nc, b, h, bq, ...] -> [b, h, sq, ...]."""
+    nc, b, h, bq = x.shape[:4]
+    return jnp.moveaxis(x, 0, 2).reshape(b, h, nc * bq, *x.shape[4:])
+
+
+def _hop_fwd(qh, k_blk, v_blk, m, l, acc, qpos, kpos, causal, nc):
+    """One ring hop of the forward online softmax, scanned over q chunks so
+    the transient probability block is [b, h, bq, sk], never [sq, sk]."""
+
+    def chunk_step(_, xs):
+        qc, mc, lc, accc, qposc = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc, k_blk,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(qposc[None, None, :, None] >= kpos[None, None, None, :],
+                          s, _NEG_INF)
+        m_new = jnp.maximum(mc, s.max(-1))
+        alpha = jnp.exp(mc - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = lc * alpha + p.sum(-1)
+        acc_new = accc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk, preferred_element_type=jnp.float32)
+        return None, (m_new, l_new, acc_new)
+
+    bq = qh.shape[2] // nc
+    xs = (_chunk(qh, nc), _chunk(m, nc), _chunk(l, nc), _chunk(acc, nc),
+          qpos.reshape(nc, bq))
+    _, (m2, l2, acc2) = jax.lax.scan(chunk_step, None, xs)
+    return _unchunk(m2), _unchunk(l2), _unchunk(acc2)
+
+
+def _ring_forward(axis_name, n_shards, causal, scale, block_q, q, k, v):
+    """Per-shard forward; returns (out [b, sq, h, d], lse [b, h, sq])."""
     my_idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    q32 = q.astype(jnp.float32) * scale
-    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    nc = sq // _pick_block(sq, block_q)
+    qh = q.transpose(0, 2, 1, 3).astype(jnp.float32) * scale
+    k_blk = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    v_blk = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    m = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, sq), jnp.float32)
     acc = jnp.zeros((b, h, sq, d), jnp.float32)
-
     qpos = my_idx * sq + jnp.arange(sq)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
-    def step(j, carry):
-        k_blk, v_blk, m, l, acc = carry
+    for j in range(n_shards):  # static unroll: n_shards is small; lets XLA
+        # overlap the ppermute with the next hop's matmuls
         src_shard = (my_idx - j) % n_shards
         kpos = src_shard * sq + jnp.arange(sq)
-        if causal:
-            mask = jnp.where(qpos[:, None] >= kpos[None, :], 0., -jnp.inf)
-        else:
-            mask = jnp.zeros((sq, sq), jnp.float32)
-        m, l, acc = _block_attn(q32, k_blk, v_blk, mask, m, l, acc)
-        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, m, l, acc
+        m, l, acc = _hop_fwd(qh, k_blk, v_blk, m, l, acc, qpos, kpos,
+                             causal, nc)
+        if j + 1 < n_shards:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
 
-    carry = (k, v, m, l, acc)
-    for j in range(n_shards):  # static unroll: n_shards is small; lets XLA
-        carry = step(j, carry)  # overlap ppermute with the next matmul
-    _, _, m, l, acc = carry
-    out = acc / jnp.maximum(l[..., None], 1e-30)           # [b, h, q, d]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _ring_core(axis_name, n_shards, causal, scale, block_q, q, k, v):
+    out, _ = _ring_forward(axis_name, n_shards, causal, scale, block_q,
+                           q, k, v)
+    return out
+
+
+def _ring_fwd_rule(axis_name, n_shards, causal, scale, block_q, q, k, v):
+    out, lse = _ring_forward(axis_name, n_shards, causal, scale, block_q,
+                             q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd_rule(axis_name, n_shards, causal, scale, block_q, res, dout):
+    """Memory-efficient backward: rotate (k, v, dk, dv) around the ring,
+    recomputing each hop's probabilities from the saved log-sum-exp chunked
+    over query rows.  Residuals are O(sq·d); transients O(bq·sq)."""
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    nc = sq // _pick_block(sq, block_q)
+    bq = sq // nc
+    my_idx = jax.lax.axis_index(axis_name)
+    f32 = jnp.float32
+    qh = q.transpose(0, 2, 1, 3).astype(f32) * scale      # pre-scaled
+    k_blk = k.transpose(0, 2, 1, 3).astype(f32)
+    v_blk = v.transpose(0, 2, 1, 3).astype(f32)
+    do = dout.transpose(0, 2, 1, 3).astype(f32)
+    ot = out.transpose(0, 2, 1, 3).astype(f32)
+    delta = jnp.sum(do * ot, -1)                          # [b, h, sq]
+    dq = jnp.zeros((b, h, sq, d), f32)
+    dk_blk = jnp.zeros((b, h, sq, d), f32)
+    dv_blk = jnp.zeros((b, h, sq, d), f32)
+    qpos = my_idx * sq + jnp.arange(sq)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def hop(k_blk, v_blk, dk_blk, dv_blk, dq, kpos):
+        def chunk_step(carry, xs):
+            dk, dv = carry
+            qc, doc, dc, lsec, qposc = xs
+            s = jnp.einsum("bhqd,bhkd->bhqk", qc, k_blk,
+                           preferred_element_type=f32)
+            if causal:
+                s = jnp.where(
+                    qposc[None, None, :, None] >= kpos[None, None, None, :],
+                    s, _NEG_INF)
+            p = jnp.exp(s - lsec[..., None])              # normalised probs
+            dp = jnp.einsum("bhqd,bhkd->bhqk", doc, v_blk,
+                            preferred_element_type=f32)
+            ds = p * (dp - dc[..., None])
+            dqc = jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk,
+                             preferred_element_type=f32) * scale
+            dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qc,
+                                 preferred_element_type=f32)
+            dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, doc,
+                                 preferred_element_type=f32)
+            return (dk, dv), dqc
+
+        xs = (_chunk(qh, nc), _chunk(do, nc), _chunk(delta, nc),
+              _chunk(lse, nc), qpos.reshape(nc, bq))
+        (dk_blk, dv_blk), dqs = jax.lax.scan(chunk_step, (dk_blk, dv_blk), xs)
+        return dk_blk, dv_blk, dq + _unchunk(dqs)
+
+    for j in range(n_shards):
+        src_shard = (my_idx - j) % n_shards
+        kpos = src_shard * sq + jnp.arange(sq)
+        dk_blk, dv_blk, dq = hop(k_blk, v_blk, dk_blk, dv_blk, dq, kpos)
+        if j + 1 < n_shards:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+            dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        else:
+            # one final rotation brings each accumulated (dk, dv) block back
+            # to its home shard
+            dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+            dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+
+    def back(x, like):
+        return x.transpose(0, 2, 1, 3).astype(like.dtype)
+
+    return back(dq, q), back(dk_blk, k), back(dv_blk, v)
+
+
+_ring_core.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "sequence", causal: bool = True,
-                   scale: typing.Optional[float] = None) -> jax.Array:
+                   scale: typing.Optional[float] = None,
+                   block_q: int = 512) -> jax.Array:
     """q, k, v: [batch, seq, heads, d] (global); returns same shape.
 
     Sharding: seq over ``axis_name``; batch over 'data' and heads over
-    'model' when those axes exist in the mesh.
+    'model' when those axes exist in the mesh.  Differentiable with
+    O(seq/P · d) residual memory (see module docstring).
     """
     n_shards = mesh.shape[axis_name]
     if scale is None:
@@ -89,7 +217,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
              "model" if "model" in mesh.axis_names else None,
              None)
     fn = jax.shard_map(
-        functools.partial(_ring_body, axis_name, n_shards, causal, scale),
+        functools.partial(_ring_core, axis_name, n_shards, causal, scale,
+                          block_q),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

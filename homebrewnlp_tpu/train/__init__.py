@@ -31,6 +31,31 @@ from ..optim.gradients import MULTI_LOSS_GRADIENTS
 Params = typing.Dict[str, jax.Array]
 
 
+def _info_metrics(info) -> typing.Dict[str, jax.Array]:
+    """Loss/accuracy metrics from a model BuildInfo (None -> 0)."""
+    return {
+        "loss": info.total_loss.data.astype(jnp.float32),
+        "token_loss": (info.token_loss.data.astype(jnp.float32)
+                       if info.token_loss is not None else jnp.float32(0)),
+        "video_loss": (info.video_loss.data.astype(jnp.float32)
+                       if info.video_loss is not None else jnp.float32(0)),
+        "accuracy": (info.accuracy.data.astype(jnp.float32)
+                     if info.accuracy is not None else jnp.float32(0)),
+    }
+
+
+def _grad_norm_metrics(grads: Params, debug: bool) -> typing.Dict[str, jax.Array]:
+    extra = {}
+    if debug:
+        # per-variable gradient norms (the reference's --debug_grad
+        # histogram summaries, src/run/run.py:147-153)
+        extra = {f"grad_norm/{k}": jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+                 for k, g in grads.items()}
+    extra["global_grad_norm"] = jnp.sqrt(sum(
+        jnp.sum(g.astype(jnp.float32) ** 2) for g in grads.values()))
+    return extra
+
+
 class TrainState(typing.NamedTuple):
     variables: Params
     opt_state: typing.Dict[str, typing.Dict[str, jax.Array]]
@@ -117,23 +142,9 @@ class Trainer:
         grads, info = self._grads(variables, batch, rng)
         new_vars, new_opt, lr = self.optimizer.update(variables, grads, opt_state,
                                                       step)
-        extra = {}
-        if self.params.debug_gradients:
-            # per-variable gradient norms (the reference's --debug_grad
-            # histogram summaries, src/run/run.py:147-153)
-            extra = {f"grad_norm/{k}": jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
-                     for k, g in grads.items()}
-        extra["global_grad_norm"] = jnp.sqrt(sum(
-            jnp.sum(g.astype(jnp.float32) ** 2) for g in grads.values()))
         metrics = {
-            **extra,
-            "loss": info.total_loss.data.astype(jnp.float32),
-            "token_loss": (info.token_loss.data.astype(jnp.float32)
-                           if info.token_loss is not None else jnp.float32(0)),
-            "video_loss": (info.video_loss.data.astype(jnp.float32)
-                           if info.video_loss is not None else jnp.float32(0)),
-            "accuracy": (info.accuracy.data.astype(jnp.float32)
-                         if info.accuracy is not None else jnp.float32(0)),
+            **_grad_norm_metrics(grads, self.params.debug_gradients),
+            **_info_metrics(info),
             "learning_rate": lr.astype(jnp.float32),
         }
         return (new_vars, new_opt, step + 1), metrics
@@ -150,14 +161,15 @@ class Trainer:
             grads, info = self._grads(variables, sub_batch, sub_rng)
             acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32) / n,
                                          acc, grads)
-            return acc, info.total_loss.data.astype(jnp.float32)
+            return acc, _info_metrics(info)
 
         zero = {k: jnp.zeros(v.shape, jnp.float32) for k, v in variables.items()}
-        grads, losses = jax.lax.scan(scan_fn, zero, (batch, rng))
+        grads, sub_metrics = jax.lax.scan(scan_fn, zero, (batch, rng))
         new_vars, new_opt, lr = self.optimizer.update(variables, grads, opt_state, step)
-        metrics = {"loss": jnp.mean(losses), "token_loss": jnp.mean(losses),
-                   "video_loss": jnp.float32(0), "accuracy": jnp.float32(0),
-                   "learning_rate": lr.astype(jnp.float32)}
+        metrics = {
+            **_grad_norm_metrics(grads, self.params.debug_gradients),
+            **{k: jnp.mean(v) for k, v in sub_metrics.items()},
+            "learning_rate": lr.astype(jnp.float32)}
         return (new_vars, new_opt, step + 1), metrics
 
     # -- the jitted step ---------------------------------------------------
@@ -198,9 +210,12 @@ class Trainer:
             self._step_fn = self._build_step()
             self._rng_counter = 0
         if rng is None:
-            # host counter, never a device sync on state.step
+            # host counter offset by the restored step, never a device sync
+            # on state.step: a resumed run continues the dropout-key
+            # sequence instead of replaying it from its first step
             self._rng_counter += 1
-            rng = jax.random.PRNGKey(self._rng_counter)
+            rng = jax.random.PRNGKey(self.params.current_step
+                                     + self._rng_counter)
         if self.mesh is not None:
             batch = shardlib.shard_batch(self.params, batch, self.mesh)
         return self._step_fn(state, batch, rng)

@@ -37,6 +37,56 @@ def endpoints_test():
     assert isinstance(out["completion"], str)
 
 
+def isolated_serving_test():
+    """Process-isolated serving (the default): HTTP runs in a subprocess,
+    requests cross Manager IPC to the device loop in this process — the
+    reference's uvicorn-subprocess + Manager-dict design."""
+    import socket
+    from homebrewnlp_tpu.infer import rest_api
+
+    interface = _interface()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    t = threading.Thread(target=rest_api.serve,
+                         args=(interface.params, interface),
+                         kwargs={"port": port, "isolate": True}, daemon=True)
+    t.start()
+
+    def post(path, payload, timeout=60):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        deadline = 30
+        import time
+        for _ in range(deadline * 4):
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return json.loads(resp.read())
+            except (ConnectionError, urllib.error.URLError):
+                time.sleep(0.25)
+        raise TimeoutError(path)
+
+    out = post("/encode", {"prompt": "hi"})
+    assert out["tokens"] == [104, 105]
+    out = post("/token_completion", {"tokens": [1, 2, 3], "temperature": 0.0})
+    assert len(out["tokens"]) == 16
+    # errors surface as HTTP 500 JSON, not a wedged device loop
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/token_completion",
+        data=json.dumps({"tokens": "bogus"}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=60)
+        raise AssertionError("expected HTTP 500")
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
+        assert "error" in json.loads(e.read())
+    # and the loop still answers afterwards
+    assert post("/decode", {"tokens": [104, 105]})["prompt"] == "hi"
+
+
 def http_server_test():
     """Full HTTP round-trip through the stdlib fallback server."""
     from http.server import ThreadingHTTPServer

@@ -78,7 +78,7 @@ def grad_accumulation_test():
     """grad_accumulation averages gradients before one update — a capability
     the reference rejects at config time (src/dataclass.py:189-191)."""
     cfg = dict(optimizer="learning_rate", learning_rate=0.1, weight_decay=0.0,
-               depth=1, train_batch_size=4)
+               depth=1, train_batch_size=4, calc_accuracy=True)
     rng = np.random.default_rng(0)
     params_a = make_params(**cfg)
     m_a = Model(params_a)
@@ -100,10 +100,20 @@ def grad_accumulation_test():
     tr_b = Trainer(params_b, m_b)
     macro = {k: jnp.stack([b1[k], b2[k]]) for k in b1}
     state_b = tr_b.init_state(macro)
-    state_b, _ = tr_b.step(state_b, macro, jax.random.PRNGKey(0))
+    init_vars = {k: np.asarray(v) for k, v in state_b.variables.items()}
+    state_b, metrics = tr_b.step(state_b, macro, jax.random.PRNGKey(0))
     for k in expected:
         np.testing.assert_allclose(np.asarray(state_b.variables[k], np.float32),
                                    expected[k], rtol=2e-4, atol=1e-6, err_msg=k)
+    # metrics fidelity through the accumulation scan: accuracy / token_loss /
+    # global_grad_norm report real values, not placeholder zeros
+    infos = [m_b.apply(init_vars, b) for b in (b1, b2)]
+    want_acc = np.mean([float(i.accuracy.data) for i in infos])
+    want_tok = np.mean([float(i.token_loss.data) for i in infos])
+    np.testing.assert_allclose(float(metrics["accuracy"]), want_acc, rtol=1e-5)
+    np.testing.assert_allclose(float(metrics["token_loss"]), want_tok,
+                               rtol=1e-5)
+    assert float(metrics["global_grad_norm"]) > 0
 
 
 def sharded_train_step_test():
