@@ -272,6 +272,13 @@ class ModelParameter:
         if self.use_random_dataloader:
             print('WARNING: Use random dataset seed')
             self.data_seed = int(np.random.default_rng().integers(0, 1_000_000))
+        if self.combine_assignments:
+            # the reference flag merged mtf assign ops into one op ("needs
+            # more memory but it's faster", dataclass.py:77); the jitted
+            # train step already applies every variable update in one fused
+            # XLA program, so the combined behaviour is always on here
+            print("combine_assignments: inherent in the jitted step "
+                  "(all updates run in one fused program); no separate effect")
 
         # ---- mesh derivation: reference's 2-D batch x heads mesh (:247-252),
         # extended with optional sequence (long-context) and pipe (pipeline

@@ -306,17 +306,37 @@ class _InterleavedStream:
 
 
 def _expand_glob(path: str) -> typing.List[str]:
-    import glob as globlib
+    from ..utils import fs
     if any(c in path for c in "*?["):
-        return sorted(globlib.glob(path))
-    if os.path.isdir(path):
-        return sorted(os.path.join(path, f) for f in os.listdir(path))
+        return fs.glob(path)
+    if fs.isdir(path):
+        return sorted(fs.join(path, f) for f in fs.listdir(path))
     return [path]
+
+
+def _shuffle_windows(it, buffer_size: int, rng):
+    """tf.data-style buffered shuffle: keep ``buffer_size`` windows, yield a
+    random one, refill (reference inputs.py:561-563 under
+    use_random_dataloader)."""
+    buf = []
+    for item in it:
+        buf.append(item)
+        if len(buf) >= buffer_size:
+            idx = int(rng.integers(len(buf)))
+            buf[idx], buf[-1] = buf[-1], buf[idx]
+            yield buf.pop()
+    rng.shuffle(buf)
+    yield from buf
 
 
 class TextDataset:
     """gpt_neo_input equivalent (reference inputs.py:528-566): yields
-    {'token_x', 'token_y'} int32 batches of shape [batch, seq/tps, tps]."""
+    {'token_x', 'token_y'} int32 batches of shape [batch, seq/tps, tps].
+
+    With ``use_random_dataloader`` the window stream is shuffled through a
+    ``shuffle_buffer``-sized buffer with an UNSEEDED rng (and the caller
+    skips run-log resume): the reference's randomized debug pipeline
+    (inputs.py:540-563, dataloader_placement.py:121)."""
 
     def __init__(self, params: ModelParameter, sub_batch_size: int,
                  slice_index: int = 0, slice_count: int = 1, runs_log=None,
@@ -352,6 +372,10 @@ class TextDataset:
     def __iter__(self):
         p = self.params
         its = [iter(s) for s in self.streams]
+        if p.use_random_dataloader:
+            shuffle_rng = np.random.default_rng()  # deliberately unseeded
+            its = [_shuffle_windows(it, p.shuffle_buffer, shuffle_rng)
+                   for it in its]
         seq_patches = p.sequence_length // p.token_patch_size
         tps = p.token_patch_size
         off = p.output_offset
@@ -409,15 +433,17 @@ class Prefetcher:
 # ---- run log (DataLog) ---------------------------------------------------
 
 def runs_log_path(params: ModelParameter) -> str:
-    return os.path.join(params.model_path, "DataLog.log")
+    from ..utils import fs
+    return fs.join(params.model_path, "DataLog.log")
 
 
 def read_runs_log(params: ModelParameter) -> typing.List[dict]:
+    from ..utils import fs
     path = runs_log_path(params)
-    if not os.path.exists(path):
+    if not fs.exists(path):
         return []
     out = []
-    with open(path) as f:
+    with fs.open_(path) as f:
         for line in f:
             line = line.strip()
             if line:
@@ -428,7 +454,8 @@ def read_runs_log(params: ModelParameter) -> typing.List[dict]:
 def append_runs_log(params: ModelParameter, steps: int, slice_count: int):
     """Record this run's data-consumption parameters
     (reference dataloader_placement.py:101-119)."""
-    os.makedirs(params.model_path, exist_ok=True)
+    from ..utils import fs
+    fs.makedirs(params.model_path)
     entry = {"steps": int(steps),
              "ctx": int(params.sequence_length),
              "slice_count": int(slice_count),
@@ -436,6 +463,6 @@ def append_runs_log(params: ModelParameter, steps: int, slice_count: int):
              "batch_size": int(params.train_batch_size),
              "grad_accumulation": int(params.grad_accumulation),
              "token_patch_size": int(params.token_patch_size)}
-    with open(runs_log_path(params), "a") as f:
+    with fs.open_(runs_log_path(params), "a") as f:
         f.write(json.dumps(entry) + "\n")
     return entry

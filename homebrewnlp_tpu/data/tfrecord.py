@@ -177,13 +177,21 @@ class RecordWriter:
     _FLUSH_BYTES = 8 << 20
 
     def __init__(self, path: str):
+        from ..utils import fs
+        self._pending: typing.List[bytes] = []
+        self._pending_bytes = 0
+        self._started = False
+        if not fs.is_local(path):
+            # remote target (e.g. gs:// / mem://): the C++ fast path needs a
+            # local fd, so frame with the python crc through the fs seam
+            self._path = str(path)
+            self._native = False
+            self._f = fs.open_(path, "wb")
+            return
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._path = os.path.abspath(path)
         from . import native_recordio
         self._native = native_recordio.available()
-        self._pending: typing.List[bytes] = []
-        self._pending_bytes = 0
-        self._started = False
         if self._native:
             # truncate eagerly so a crash before the first flush can't leave
             # a previous run's complete file looking valid
@@ -238,7 +246,8 @@ def read_records(path: str, verify_crc: bool = False
     if native_recordio.available() and not verify_crc:
         yield from native_recordio.read_records(path)
         return
-    with open(path, "rb") as f:
+    from ..utils import fs
+    with fs.open_(path, "rb") as f:
         while True:
             header = f.read(12)
             if len(header) < 12:

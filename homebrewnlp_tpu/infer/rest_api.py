@@ -101,19 +101,22 @@ def _run_http(port: int, paths: typing.List[str],
     ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()
 
 
+DISPATCH_DEADLINE_S = 600.0
+
+
 def _http_child(port: int, paths: typing.List[str], requests, responses,
-                workers: int, deadline_s: float = 600.0):
+                workers: int, deadline_s: float = DISPATCH_DEADLINE_S):
     """Subprocess body: HTTP in, Manager IPC to the device loop out."""
     def dispatch(path: str, body: dict) -> dict:
         rid = uuid.uuid4().hex
-        requests.put((rid, path, body))
+        requests.put((rid, time.time(), path, body))
         t0 = time.time()
         while rid not in responses:
             if time.time() - t0 > deadline_s:
                 raise RuntimeError("device loop did not answer within "
                                    f"{deadline_s}s")
             time.sleep(0.002)
-        out = responses.pop(rid)
+        out = responses.pop(rid)["r"]
         if isinstance(out, dict) and "_error" in out:
             raise RuntimeError(out["_error"])
         return out
@@ -147,17 +150,26 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
           f"in main process)")
     # the device loop: strictly serialized completions in the process that
     # owns the model.  Poll with a timeout so a dead HTTP child (e.g. the
-    # port was already bound) surfaces instead of blocking forever.
+    # port was already bound) surfaces instead of blocking forever.  Requests
+    # older than the HTTP deadline are dropped (their client already got a
+    # 500), and answers nobody collected are pruned so the Manager dict
+    # cannot grow without bound under slow traffic.
     while True:
         try:
-            rid, path, body = requests.get(timeout=1.0)
+            rid, t_enq, path, body = requests.get(timeout=1.0)
         except queue_mod.Empty:
             if not proc.is_alive():
                 raise RuntimeError(
                     f"HTTP subprocess exited (code {proc.exitcode}); "
                     "is the port already in use?")
             continue
+        now = time.time()
+        for old_rid, entry in list(responses.items()):
+            if now - entry["t"] > DISPATCH_DEADLINE_S:
+                responses.pop(old_rid, None)
+        if now - t_enq > DISPATCH_DEADLINE_S:
+            continue  # client gave up; don't burn device time on it
         try:
-            responses[rid] = handlers[path](body)
+            responses[rid] = {"t": now, "r": handlers[path](body)}
         except Exception as e:
-            responses[rid] = {"_error": str(e)}
+            responses[rid] = {"t": now, "r": {"_error": str(e)}}

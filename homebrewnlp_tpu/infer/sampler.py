@@ -60,11 +60,11 @@ def make_sampler(model: Model) -> typing.Callable:
     return sample
 
 
-def init_decode_caches(model: Model, variables, token_x) -> dict:
-    """Zero-filled cache pytree for ``make_kv_sampler`` (structure discovered
-    abstractly via eval_shape — no device compute).
+def decode_cache_shapes(model: Model, variables, token_x) -> dict:
+    """Cache pytree STRUCTURE for ``make_kv_sampler`` (discovered abstractly
+    via eval_shape — no device compute; callable at trace time).
 
-    When the decode scan engages, the caches are returned DEPTH-STACKED
+    When the decode scan engages, the caches are DEPTH-STACKED
     (``model.blocks.stack_decode_caches``) so the sampler's while_loop carry
     feeds the scan as xs directly — the per-token flat<->stacked restack was
     hundreds of MB of HBM traffic per token at flagship size
@@ -77,10 +77,12 @@ def init_decode_caches(model: Model, variables, token_x) -> dict:
     shapes = jax.eval_shape(
         lambda v, t: model.apply_decode(v, t, jnp.int32(0), {})[1],
         variables, tok0)
-    flat = {k: jnp.zeros(v.shape, v.dtype) for k, v in shapes.items()}
-    stacked = blocks_mod.stack_decode_caches(model.params, flat)
+    # abstract stacking: eval_shape lets jnp.stack run on shape structs
+    stacked = jax.eval_shape(
+        lambda f: blocks_mod.stack_decode_caches(model.params, f),
+        dict(shapes))
     if not any(k.startswith(blocks_mod.STACKED_CACHE_PREFIX) for k in stacked):
-        return flat
+        return dict(shapes)
     try:
         out_shapes = jax.eval_shape(
             lambda v, t, c: model.apply_decode(v, t, jnp.int32(0), c)[1],
@@ -92,11 +94,22 @@ def init_decode_caches(model: Model, variables, token_x) -> dict:
         import warnings
         warnings.warn(f"stacked decode-cache probe failed ({e!r}); "
                       "falling back to the flat (slower) cache layout")
-        return flat
+        return dict(shapes)
     same_structure = (set(out_shapes) == set(stacked)
                       and all(out_shapes[k].shape == tuple(stacked[k].shape)
                               for k in stacked))
-    return stacked if same_structure else flat
+    return stacked if same_structure else dict(shapes)
+
+
+def init_decode_caches(model: Model, variables, token_x) -> dict:
+    """Zero-filled cache pytree (materialised ``decode_cache_shapes``).
+
+    Prefer passing ``caches=None`` to the sampler: it then builds the zeros
+    INSIDE the jitted computation, so no host-side cache allocation exists —
+    passing multi-GB zero buffers as jit arguments kept a second, unusable
+    donated copy live (what pushed flagship batch-32 decoding out of HBM)."""
+    return {k: jnp.zeros(v.shape, v.dtype)
+            for k, v in decode_cache_shapes(model, variables, token_x).items()}
 
 
 def make_kv_sampler(model: Model) -> typing.Callable:
@@ -117,7 +130,13 @@ def make_kv_sampler(model: Model) -> typing.Callable:
     loop).
     """
     def sample(variables, token_x, initial_pos, temperature, end_iterations,
-               key, caches):
+               key, caches=None):
+        if not caches:
+            # build the zero caches INSIDE the trace: passing them as jit
+            # arguments keeps an unusable donated copy live — 2x cache HBM,
+            # which is what pushed flagship batch-32 decode out of memory
+            caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
+                      decode_cache_shapes(model, variables, token_x).items()}
         # iterations at position >= seq are no-ops in the full sampler (its
         # one-hot write misses); clamp instead of letting the update clamp
         end_iterations = jnp.minimum(end_iterations, token_x.shape[1])
@@ -188,13 +207,12 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
         end_iterations = seq
     if use_cache and not params.use_video:
         try:
-            caches = init_decode_caches(model, variables, jnp.asarray(token_x))
             fn = jax.jit(make_kv_sampler(model))
             out = fn(variables, jnp.asarray(token_x),
                      jnp.asarray(initial_pos, jnp.int32),
                      jnp.asarray(temperature, jnp.float32),
                      jnp.asarray(end_iterations, jnp.int32),
-                     jax.random.PRNGKey(seed), caches)
+                     jax.random.PRNGKey(seed), None)
             return np.asarray(out)
         except NotImplementedError:
             pass  # layer without a streaming form: full-forward fallback

@@ -12,6 +12,7 @@ import typing
 import numpy as np
 
 from ..config import ModelParameter
+from ..utils import fs
 
 
 def analyze_model(params: ModelParameter, variables: typing.Dict[str, np.ndarray],
@@ -41,7 +42,7 @@ def analyze_model(params: ModelParameter, variables: typing.Dict[str, np.ndarray
     print(f"  variables:          {len(sizes)}")
     print(f"  dimensions:         {', '.join(dims)}")
     if dump:
-        os.makedirs(params.model_path, exist_ok=True)
-        with open(os.path.join(params.model_path, "model_size.info"), "w") as f:
+        fs.makedirs(params.model_path)
+        with fs.open_(fs.join(params.model_path, "model_size.info"), "w") as f:
             json.dump(report, f, indent=2)
     return report

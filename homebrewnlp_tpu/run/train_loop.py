@@ -26,12 +26,13 @@ from ..model import Model
 from ..train import Trainer
 from ..train import checkpoint as ckpt
 from ..train.metrics import MetricLogger
+from ..utils import fs
 from .analysis import analyze_model
 
 
 def _dump_run_config(params: ModelParameter):
-    os.makedirs(params.model_path, exist_ok=True)
-    path = os.path.join(params.model_path, f"run_config_{int(time.time())}.json")
+    fs.makedirs(params.model_path)
+    path = fs.join(params.model_path, f"run_config_{int(time.time())}.json")
     safe = {}
     for k, v in params.dict().items():
         try:
@@ -39,7 +40,7 @@ def _dump_run_config(params: ModelParameter):
             safe[k] = v
         except TypeError:
             safe[k] = str(v)
-    with open(path, "w") as f:
+    with fs.open_(path, "w") as f:
         json.dump(safe, f, indent=2)
 
 
@@ -71,10 +72,20 @@ def data_slice_geometry(mesh=None):
 
 
 def make_dataset(params: ModelParameter, repeat: bool = True, mesh=None):
-    runs_log = read_runs_log(params)
+    # use_random_dataloader: randomized debug pipeline — no deterministic
+    # resume (reference dataloader_placement.py:121,155)
+    runs_log = [] if params.use_random_dataloader else read_runs_log(params)
     # each process loads only its slice of the global batch; shard_batch
     # assembles the slices via make_array_from_process_local_data
     slice_index, slice_count = data_slice_geometry(mesh)
+    if params.use_random_dataloader and slice_count < max(1, jax.process_count()):
+        # several processes feed the SAME batch slice (full model
+        # parallelism): each process's unseeded shuffle would order windows
+        # differently and the assembled global batch would mix them —
+        # duplicated and dropped windows with no error
+        raise ValueError("use_random_dataloader requires per-process data "
+                         "slices; this layout replicates batches across "
+                         "processes, which an unseeded shuffle would desync")
     if params.train_batch_size % slice_count:
         raise ValueError(f"train_batch_size {params.train_batch_size} must "
                          f"divide evenly over {slice_count} batch slices")
@@ -89,7 +100,7 @@ def make_dataset(params: ModelParameter, repeat: bool = True, mesh=None):
         dataset: typing.Iterable = mixed_dataset(
             params, params.train_batch_size // slice_count,
             slice_index=slice_index, slice_count=slice_count, repeat=repeat)
-        if params.current_step:
+        if params.current_step and not params.use_random_dataloader:
             # sub-batches consumed == step counter: each macro-group consumes
             # macro_batching sub-batches AND advances the step by the same
             dataset = itertools.islice(dataset, params.current_step, None)
@@ -146,7 +157,17 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # analyze_model reads shapes only — no device_get (which would also
         # fail on non-fully-addressable arrays in multi-host model sharding)
         analyze_model(params, state.variables, model.param_dims)
-        append_runs_log(params, 0, data_slice_geometry(mesh)[1])
+        if not params.use_random_dataloader:
+            # a shuffled run consumes windows out of order: logging it would
+            # poison a later deterministic run's skip replay
+            append_runs_log(params, 0, data_slice_geometry(mesh)[1])
+        if params.save_graph:
+            # reference saved the TF graph_def with checkpoints
+            # (run.py:171); the XLA-native artifact is the lowered step
+            path = fs.join(params.model_path, "train_step.stablehlo.txt")
+            with fs.open_(path, "w") as f:
+                f.write(trainer.lowered(state, first_batch).as_text())
+            print(f"save_graph: lowered train step written to {path}")
 
     logger = MetricLogger(params.model_path) if is_chief else None
     total_steps = train_steps if train_steps is not None else params.train_steps
@@ -175,6 +196,11 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             state, metrics = trainer.step(state, batch)
             steps_done += params.macro_batching
             step_now += params.macro_batching
+            if params.debug_train_step:
+                # reference run.py:252-262 verbose stepping (host-side only;
+                # fetching metrics here would force a device sync per step)
+                print(f"debug_train_step: dispatched step {step_now}; "
+                      f"fetching next batch", flush=True)
             try:
                 batch = next(data_it)
             except StopIteration:
@@ -198,10 +224,11 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             ckpt.save(params.model_path, int(state.step), state.variables,
                       state.opt_state, params.max_checkpoints_keep)
         # rewrite the run log entry with the steps actually consumed
-        log = read_runs_log(params) if is_chief else None
+        log = read_runs_log(params) \
+            if is_chief and not params.use_random_dataloader else None
         if log:
             log[-1]["steps"] = steps_done
-            with open(os.path.join(params.model_path, "DataLog.log"), "w") as f:
+            with fs.open_(fs.join(params.model_path, "DataLog.log"), "w") as f:
                 for entry in log:
                     f.write(json.dumps(entry) + "\n")
         if logger is not None:

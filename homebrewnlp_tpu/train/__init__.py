@@ -204,6 +204,16 @@ class Trainer:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def lowered(self, state: TrainState, batch: typing.Dict[str, jax.Array]):
+        """Lowered (StableHLO) train step for ``save_graph`` dumps — the
+        TPU-native analogue of the reference's save_graph_def
+        (src/run/run.py:171)."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        if self.mesh is not None:
+            batch = shardlib.shard_batch(self.params, batch, self.mesh)
+        return self._step_fn.lower(state, batch, jax.random.PRNGKey(0))
+
     def step(self, state: TrainState, batch: typing.Dict[str, jax.Array],
              rng: typing.Optional[jax.Array] = None):
         if self._step_fn is None:

@@ -18,13 +18,13 @@ recorded in the manifest), tensorstore-style.
 from __future__ import annotations
 
 import json
-import os
 import re
-import shutil
 import typing
 
 import jax
 import numpy as np
+
+from ..utils import fs
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)$")
 
@@ -42,12 +42,16 @@ def _np_dtype(name: str):
 
 
 def list_checkpoints(model_path: str) -> typing.List[int]:
-    if not os.path.isdir(model_path):
+    if not fs.isdir(model_path):
         return []
     steps = []
-    for entry in os.listdir(model_path):
+    for entry in fs.listdir(model_path):
         m = _CKPT_RE.match(entry)
-        if m:
+        if not m:
+            continue
+        # object-store replace is not atomic: a checkpoint is complete only
+        # once its index.json (written last) exists
+        if fs.exists(fs.join(model_path, entry, "index.json")):
             steps.append(int(m.group(1)))
     return sorted(steps)
 
@@ -103,9 +107,9 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
     if nproc > 1:
         return _save_distributed(model_path, step, variables, opt_state,
                                  max_keep, extra)
-    ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
+    ckpt_dir = fs.join(model_path, f"ckpt_{int(step)}")
     tmp_dir = ckpt_dir + ".tmp"
-    os.makedirs(tmp_dir, exist_ok=True)
+    fs.makedirs(tmp_dir)
     manifest: typing.Dict[str, typing.Any] = {
         "step": int(step),
         "process_index": jax.process_index(),
@@ -132,22 +136,21 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
         for (idx, key, _), value in zip(chunk, fetched):
             host = np.asarray(value)
             fname = f"arr_{idx:06d}.bin"
-            with open(os.path.join(tmp_dir, fname), "wb") as f:
-                host.tofile(f)
+            with fs.open_(fs.join(tmp_dir, fname), "wb") as f:
+                f.write(host.tobytes())
             manifest["arrays"][key] = {"file": fname,
                                        "shape": list(host.shape),
                                        "dtype": _dtype_name(host.dtype)}
-    with open(os.path.join(tmp_dir, "index.json"), "w") as f:
+    with fs.open_(fs.join(tmp_dir, "index.json"), "w") as f:
         json.dump(manifest, f)
-    if os.path.exists(ckpt_dir):
-        shutil.rmtree(ckpt_dir)
-    os.replace(tmp_dir, ckpt_dir)
+    if fs.exists(ckpt_dir):
+        fs.rmtree(ckpt_dir)
+    fs.replace(tmp_dir, ckpt_dir)
 
     if max_keep > 0:
         steps = list_checkpoints(model_path)
         for old in steps[:-max_keep]:
-            shutil.rmtree(os.path.join(model_path, f"ckpt_{old}"),
-                          ignore_errors=True)
+            fs.rmtree(fs.join(model_path, f"ckpt_{old}"))
     return ckpt_dir
 
 
@@ -159,16 +162,16 @@ def multihost_utils_sync(tag: str) -> None:
 def _save_distributed(model_path: str, step: int, variables, opt_state,
                       max_keep: int, extra: typing.Optional[dict]) -> str:
     pid = jax.process_index()
-    ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
+    ckpt_dir = fs.join(model_path, f"ckpt_{int(step)}")
     tmp_dir = ckpt_dir + ".tmp"
     # a crashed earlier save (possibly from a run with MORE processes) may
     # have left stale shard files in the tmp dir; restore() reads every
     # shards_*.json, so stale files would corrupt the reassembly — clear
     # before anyone writes, then barrier
-    if pid == 0 and os.path.exists(tmp_dir):
-        shutil.rmtree(tmp_dir)
+    if pid == 0 and fs.exists(tmp_dir):
+        fs.rmtree(tmp_dir)
     multihost_utils_sync(f"ckpt_clear_{step}")
-    os.makedirs(tmp_dir, exist_ok=True)
+    fs.makedirs(tmp_dir)
     tree = {"variables": variables, "opt_state": opt_state}
     leaves = list(_leaf_files(tree))
 
@@ -192,8 +195,8 @@ def _save_distributed(model_path: str, step: int, variables, opt_state,
     fetched_shards = jax.device_get(shard_data_refs)
     for (i, key, j, index, value), host in zip(shard_meta, fetched_shards):
         fname = f"arr_{i:06d}_p{pid}_s{j}.bin"
-        with open(os.path.join(tmp_dir, fname), "wb") as f:
-            np.asarray(host).tofile(f)
+        with fs.open_(fs.join(tmp_dir, fname), "wb") as f:
+            f.write(np.asarray(host).tobytes())
         shard_entries.append({
             "key": key, "file": fname,
             "index": _slice_spec(index, value.shape),
@@ -204,27 +207,26 @@ def _save_distributed(model_path: str, step: int, variables, opt_state,
         for (i, key, _), value in zip(chief_fetch, fetched):
             host = np.asarray(value)
             fname = f"arr_{i:06d}.bin"
-            with open(os.path.join(tmp_dir, fname), "wb") as f:
-                host.tofile(f)
+            with fs.open_(fs.join(tmp_dir, fname), "wb") as f:
+                f.write(host.tobytes())
             chief_arrays[key] = {"file": fname, "shape": list(host.shape),
                                  "dtype": _dtype_name(host.dtype)}
-    with open(os.path.join(tmp_dir, f"shards_{pid}.json"), "w") as f:
+    with fs.open_(fs.join(tmp_dir, f"shards_{pid}.json"), "w") as f:
         json.dump({"process_index": pid, "shards": shard_entries}, f)
     if pid == 0:
-        with open(os.path.join(tmp_dir, "index.json"), "w") as f:
+        with fs.open_(fs.join(tmp_dir, "index.json"), "w") as f:
             json.dump({"step": int(step), "distributed": True,
                        "process_count": jax.process_count(),
                        "arrays": chief_arrays, "extra": extra or {}}, f)
     # every process must have flushed before the directory becomes visible
     multihost_utils_sync(f"ckpt_save_{step}")
     if pid == 0:
-        if os.path.exists(ckpt_dir):
-            shutil.rmtree(ckpt_dir)
-        os.replace(tmp_dir, ckpt_dir)
+        if fs.exists(ckpt_dir):
+            fs.rmtree(ckpt_dir)
+        fs.replace(tmp_dir, ckpt_dir)
         if max_keep > 0:
             for old in list_checkpoints(model_path)[:-max_keep]:
-                shutil.rmtree(os.path.join(model_path, f"ckpt_{old}"),
-                              ignore_errors=True)
+                fs.rmtree(fs.join(model_path, f"ckpt_{old}"))
     multihost_utils_sync(f"ckpt_done_{step}")
     return ckpt_dir
 
@@ -241,28 +243,27 @@ def restore(model_path: str, step: typing.Optional[int] = None
         if not steps:
             return None
         step = steps[-1]
-    ckpt_dir = os.path.join(model_path, f"ckpt_{int(step)}")
-    with open(os.path.join(ckpt_dir, "index.json")) as f:
+    ckpt_dir = fs.join(model_path, f"ckpt_{int(step)}")
+    with fs.open_(fs.join(ckpt_dir, "index.json")) as f:
         manifest = json.load(f)
     tree: dict = {"variables": {}, "opt_state": {}}
     for key, meta in manifest["arrays"].items():
-        with open(os.path.join(ckpt_dir, meta["file"]), "rb") as f:
+        with fs.open_(fs.join(ckpt_dir, meta["file"]), "rb") as f:
             raw = f.read()
         arr = np.frombuffer(raw, dtype=_np_dtype(meta["dtype"]))
         arr = arr.reshape(meta["shape"]).copy()
         _set_leaf(tree, key, arr)
     if manifest.get("distributed"):
         assembled: typing.Dict[str, np.ndarray] = {}
-        import glob as _glob
-        for mpath in sorted(_glob.glob(os.path.join(ckpt_dir, "shards_*.json"))):
-            with open(mpath) as f:
+        for mpath in fs.glob(fs.join(ckpt_dir, "shards_*.json")):
+            with fs.open_(mpath) as f:
                 shard_manifest = json.load(f)
             for entry in shard_manifest["shards"]:
                 key = entry["key"]
                 if key not in assembled:
                     assembled[key] = np.empty(entry["global_shape"],
                                               _np_dtype(entry["dtype"]))
-                with open(os.path.join(ckpt_dir, entry["file"]), "rb") as f:
+                with fs.open_(fs.join(ckpt_dir, entry["file"]), "rb") as f:
                     raw = f.read()
                 idx = tuple(slice(lo, hi) for lo, hi in entry["index"])
                 part = np.frombuffer(raw, dtype=_np_dtype(entry["dtype"]))

@@ -10,13 +10,13 @@ data/tfrecord.py covers it.  Console logging mirrors utils_core.color_print.
 from __future__ import annotations
 
 import json
-import os
 import socket
 import struct
 import time
 import typing
 
 from ..data.tfrecord import RecordWriter, _len_delim, _varint
+from ..utils import fs
 
 
 def _float_field(field: int, value: float) -> bytes:
@@ -51,9 +51,9 @@ class SummaryWriter:
     """TensorBoard-compatible scalar writer."""
 
     def __init__(self, logdir: str):
-        os.makedirs(logdir, exist_ok=True)
+        fs.makedirs(logdir)
         fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
-        self._writer = RecordWriter(os.path.join(logdir, fname))
+        self._writer = RecordWriter(fs.join(logdir, fname))
         self._writer.write(encode_file_version_event())
 
     def scalar(self, tag: str, value: float, step: int):
@@ -69,14 +69,21 @@ class SummaryWriter:
 class MetricLogger:
     """Console + JSONL + TensorBoard in one call."""
 
+    #: remote flush cadence: an object-store "flush" re-uploads the whole
+    #: accumulated file (no true append), so flushing every step would be
+    #: O(n^2) bytes over a run
+    REMOTE_FLUSH_S = 30.0
+
     def __init__(self, model_path: str, enable_tb: bool = True):
         self.model_path = model_path
-        os.makedirs(model_path, exist_ok=True)
-        self.jsonl = open(os.path.join(model_path, "metrics.jsonl"), "a")
+        fs.makedirs(model_path)
+        self.jsonl = fs.open_(fs.join(model_path, "metrics.jsonl"), "a")
         self.tb = SummaryWriter(model_path) if enable_tb else None
         self._t0 = time.time()
         self._last_step_time = self._t0
         self._last_step = None
+        self._local = fs.is_local(model_path)
+        self._last_flush = 0.0
 
     def log(self, step: int, metrics: typing.Dict[str, typing.Any],
             tokens_per_step: typing.Optional[int] = None):
@@ -91,11 +98,14 @@ class MetricLogger:
         self._last_step_time = now
         entry = {"step": int(step), "wall": now - self._t0, **vals}
         self.jsonl.write(json.dumps(entry) + "\n")
-        self.jsonl.flush()
         if self.tb is not None:
             for k, v in vals.items():
                 self.tb.scalar(k, v, step)
-            self.tb.flush()
+        if self._local or now - self._last_flush > self.REMOTE_FLUSH_S:
+            self.jsonl.flush()
+            if self.tb is not None:
+                self.tb.flush()
+            self._last_flush = now
         stamp = time.strftime("%H:%M:%S")
         parts = " ".join(f"{k}={v:.5g}" for k, v in vals.items())
         print(f"\x1b[32;1m[{stamp}]\x1b[0m step={step} {parts}", flush=True)

@@ -1,0 +1,342 @@
+"""Pluggable filesystem seam: one surface for every path the framework
+touches (checkpoints, DataLog, metrics, config dumps, dataset records).
+
+The reference ran everything against GCS through TF's GFile
+(/root/reference/src/inputs.py:524-559, scripts/run_manager.py:26-56); here
+the same role is a small registry keyed on URL scheme:
+
+    fs.open_(path, mode) / exists / isdir / listdir / makedirs / glob /
+    replace / rmtree / remove
+
+* ``LocalFS`` (default, no scheme or ``file://``) — os/shutil/glob.
+* ``GCSFS`` (``gs://``) — behind the optional ``google-cloud-storage``
+  dependency; constructed lazily on first use so local-only installs never
+  import it.
+* ``MemFS`` (``mem://``) — in-process object store with OBJECT-STORE
+  semantics (prefix listing, non-atomic directory replace implemented as
+  ordered copy+delete, no true append) used by tests to prove consumers
+  survive remote-storage behaviour.
+
+Object-store note: ``replace`` of a directory is NOT atomic on object
+stores.  Consumers that need crash-safety order their writes so a
+completeness marker lands last (checkpoint.py writes ``index.json`` after
+the shard files and ``latest_step`` ignores directories without it).
+"""
+from __future__ import annotations
+
+import glob as globlib
+import io
+import os
+import posixpath
+import shutil
+import typing
+
+
+class FileSystem:
+    def open_(self, path: str, mode: str = "r"): raise NotImplementedError
+
+    def exists(self, path: str) -> bool: raise NotImplementedError
+
+    def isdir(self, path: str) -> bool: raise NotImplementedError
+
+    def listdir(self, path: str) -> typing.List[str]: raise NotImplementedError
+
+    def makedirs(self, path: str): raise NotImplementedError
+
+    def glob(self, pattern: str) -> typing.List[str]: raise NotImplementedError
+
+    def replace(self, src: str, dst: str): raise NotImplementedError
+
+    def rmtree(self, path: str): raise NotImplementedError
+
+    def remove(self, path: str): raise NotImplementedError
+
+    #: True when paths are plain local paths C extensions can open directly
+    is_local = False
+
+
+class LocalFS(FileSystem):
+    is_local = True
+
+    def open_(self, path, mode="r"):
+        if any(m in mode for m in ("w", "a", "x")):
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+        return open(path, mode)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def isdir(self, path):
+        return os.path.isdir(path)
+
+    def listdir(self, path):
+        return os.listdir(path)
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def glob(self, pattern):
+        return sorted(globlib.glob(pattern))
+
+    def replace(self, src, dst):
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.replace(src, dst)
+
+    def rmtree(self, path):
+        shutil.rmtree(path, ignore_errors=True)
+
+    def remove(self, path):
+        os.remove(path)
+
+
+class _ObjectStoreFS(FileSystem):
+    """Shared directory-emulation logic for flat object stores: directories
+    exist implicitly as key prefixes; replace = ordered copy+delete."""
+
+    def _keys(self, prefix: str) -> typing.List[str]:
+        raise NotImplementedError
+
+    def _read(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def _write(self, key: str, data: bytes):
+        raise NotImplementedError
+
+    def _delete(self, key: str):
+        raise NotImplementedError
+
+    # -- FileSystem surface over those four primitives ---------------------
+    def open_(self, path, mode="r"):
+        binary = "b" in mode
+        if "r" in mode:
+            data = self._read(path)
+            return io.BytesIO(data) if binary else \
+                io.StringIO(data.decode("utf-8"))
+        fs = self
+
+        class _Writer(io.BytesIO if binary else io.StringIO):
+            def __init__(self, initial=""):
+                super().__init__()
+                if initial:
+                    self.write(initial)
+
+            def flush(self):
+                data = self.getvalue()
+                fs._write(path, data if binary else data.encode("utf-8"))
+
+            def close(self):
+                self.flush()
+                super().close()
+
+            def __exit__(self, *exc):
+                self.close()
+
+        if "a" in mode and self.exists(path):
+            # no true append on object stores: read-modify-write on close
+            prev = self._read(path)
+            return _Writer(prev if binary else prev.decode("utf-8"))
+        return _Writer()
+
+    def exists(self, path):
+        return bool(self._keys(path))
+
+    def isdir(self, path):
+        keys = self._keys(path.rstrip("/") + "/")
+        return bool(keys)
+
+    def listdir(self, path):
+        prefix = path.rstrip("/") + "/"
+        names = set()
+        for key in self._keys(prefix):
+            rest = key[len(prefix):]
+            if rest:
+                names.add(rest.split("/")[0])
+        return sorted(names)
+
+    def makedirs(self, path):
+        pass  # directories are implicit
+
+    def glob(self, pattern):
+        if not any(c in pattern for c in "*?["):
+            return [pattern] if self._keys(pattern) else []
+        import fnmatch
+        base = pattern.split("*")[0].split("?")[0].split("[")[0].rsplit("/", 1)[0]
+        pat_parts = pattern.split("/")
+        out = []
+        for key in self._keys(base):
+            # segment-wise match so '*' does NOT cross '/' — identical
+            # semantics to LocalFS/glob (a recursive remote '*' would feed
+            # nested stale objects into the record reader)
+            parts = key.split("/")
+            if len(parts) == len(pat_parts) and all(
+                    fnmatch.fnmatch(p, q) for p, q in zip(parts, pat_parts)):
+                out.append(key)
+        return sorted(out)
+
+    def replace(self, src, dst):
+        src_prefix = src.rstrip("/")
+        dst_prefix = dst.rstrip("/")
+        exact = self._keys(src_prefix)
+        if exact == [src_prefix]:  # single object
+            self._write(dst_prefix, self._read(src_prefix))
+            self._delete(src_prefix)
+            return
+        self.rmtree(dst_prefix)
+        # copy completeness markers (index.json) LAST: replace is not atomic
+        # on object stores, and readers treat a directory without its marker
+        # as incomplete — a crash mid-copy must never leave a marker without
+        # the data files it indexes
+        keys = list(self._keys(src_prefix + "/"))
+        keys.sort(key=lambda k: (k.split("/")[-1] == "index.json", k))
+        for key in keys:
+            self._write(dst_prefix + key[len(src_prefix):], self._read(key))
+        for key in keys:
+            self._delete(key)
+
+    def rmtree(self, path):
+        prefix = path.rstrip("/")
+        for key in list(self._keys(prefix + "/")) + list(
+                k for k in self._keys(prefix) if k == prefix):
+            self._delete(key)
+
+    def remove(self, path):
+        self._delete(path)
+
+
+class MemFS(_ObjectStoreFS):
+    """In-process object store for tests (``mem://``)."""
+
+    def __init__(self):
+        self.objects: typing.Dict[str, bytes] = {}
+
+    def _keys(self, prefix):
+        return sorted(k for k in self.objects
+                      if k == prefix or k.startswith(prefix.rstrip("/") + "/")
+                      or (prefix.endswith("/") and k.startswith(prefix)))
+
+    def _read(self, key):
+        if key not in self.objects:
+            raise FileNotFoundError(key)
+        return self.objects[key]
+
+    def _write(self, key, data):
+        self.objects[key] = bytes(data)
+
+    def _delete(self, key):
+        self.objects.pop(key, None)
+
+
+class GCSFS(_ObjectStoreFS):
+    """gs:// via the optional google-cloud-storage package."""
+
+    def __init__(self):
+        try:
+            from google.cloud import storage  # noqa
+        except ImportError as e:
+            raise ImportError(
+                "gs:// paths need the optional google-cloud-storage "
+                "dependency (pip install google-cloud-storage)") from e
+        self._client = storage.Client()
+
+    def _split(self, key):
+        rest = key[len("gs://"):]
+        bucket, _, name = rest.partition("/")
+        return self._client.bucket(bucket), name
+
+    def _keys(self, prefix):
+        bucket, name = self._split(prefix)
+        out = [f"gs://{bucket.name}/{b.name}"
+               for b in bucket.list_blobs(prefix=name)]
+        return [k for k in out
+                if k == prefix or k.startswith(prefix.rstrip("/") + "/")
+                or (prefix.endswith("/") and k.startswith(prefix))]
+
+    def _read(self, key):
+        bucket, name = self._split(key)
+        return bucket.blob(name).download_as_bytes()
+
+    def _write(self, key, data):
+        bucket, name = self._split(key)
+        bucket.blob(name).upload_from_string(bytes(data))
+
+    def _delete(self, key):
+        bucket, name = self._split(key)
+        bucket.blob(name).delete()
+
+
+_local = LocalFS()
+_registry: typing.Dict[str, typing.Union[FileSystem, typing.Callable[[], FileSystem]]] = {
+    "gs": GCSFS,   # instantiated lazily: may raise ImportError with guidance
+    "mem": MemFS,
+}
+
+
+def register(scheme: str, fs: FileSystem):
+    """Install (or replace) the filesystem serving ``scheme://`` paths."""
+    _registry[scheme] = fs
+
+
+def for_path(path: str) -> FileSystem:
+    path = str(path)
+    if "://" not in path:
+        return _local
+    scheme = path.split("://", 1)[0]
+    fs = _registry.get(scheme)
+    if fs is None:
+        raise ValueError(f"no filesystem registered for {scheme}:// paths")
+    if isinstance(fs, type):
+        fs = fs()
+        _registry[scheme] = fs
+    return fs
+
+
+def join(*parts: str) -> str:
+    """Path join that keeps URL schemes intact."""
+    if "://" in str(parts[0]):
+        return posixpath.join(*[str(p) for p in parts])
+    return os.path.join(*parts)
+
+
+# module-level convenience wrappers -----------------------------------------
+
+def open_(path, mode="r"):
+    return for_path(path).open_(str(path), mode)
+
+
+def exists(path):
+    return for_path(path).exists(str(path))
+
+
+def isdir(path):
+    return for_path(path).isdir(str(path))
+
+
+def listdir(path):
+    return for_path(path).listdir(str(path))
+
+
+def makedirs(path):
+    return for_path(path).makedirs(str(path))
+
+
+def glob(pattern):
+    return for_path(pattern).glob(str(pattern))
+
+
+def replace(src, dst):
+    return for_path(src).replace(str(src), str(dst))
+
+
+def rmtree(path):
+    return for_path(path).rmtree(str(path))
+
+
+def remove(path):
+    return for_path(path).remove(str(path))
+
+
+def is_local(path) -> bool:
+    return for_path(path).is_local

@@ -1,0 +1,82 @@
+"""Decode throughput bench: KV-cached sampling at the flagship recipe.
+
+Generates a full sequence with the cached sampler (infer/sampler.py) at the
+given batch sizes and reports ms/token and aggregate tokens/sec as JSON
+lines.  Run on the TPU chip:
+
+  nohup python scripts/bench_decode.py --batches 1,8,32 > decode_bench.log &
+
+Timing notes (docs/PERFORMANCE.md): the whole generation runs inside ONE
+jitted while_loop call, so per-dispatch tunnel latency amortises; sync is by
+value materialisation.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="configs/32big_mixer.json")
+    ap.add_argument("--batches", default="1,8,32")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.infer.sampler import make_kv_sampler
+    from homebrewnlp_tpu.model import Model
+
+    with open(args.config) as f:
+        cfg = json.load(f)
+    cfg.update({"use_checkpointing": False, "dataset_configs": [],
+                "model_path": "/tmp/bench_decode"})
+
+    for batch in [int(b) for b in args.batches.split(",")]:
+        cfg["train_batch_size"] = batch
+        params = ModelParameter(dict(cfg), train=False)
+        model = Model(params)
+        seq = params.sequence_length // params.token_patch_size
+        tps = params.token_patch_size
+        x = np.zeros((batch, seq, tps), np.int32)
+        variables = model.init({"token_x": x, "token_y": x})
+        variables = {k: jnp.asarray(v) for k, v in variables.items()}
+        token_x = jnp.zeros((batch, seq, tps), jnp.int32)
+        try:
+            # caches=None: zeros built inside the trace — no host-side cache
+            # allocation, no unusable-donation double buffer
+            fn = jax.jit(make_kv_sampler(model))
+            t_compile = time.time()
+            out = fn(variables, token_x, jnp.int32(1), jnp.float32(0.8),
+                     jnp.int32(seq), jax.random.PRNGKey(0), None)
+            np.asarray(out)  # sync by value
+            compile_s = time.time() - t_compile
+            times = []
+            for r in range(args.repeats):
+                t0 = time.time()
+                out = fn(variables, token_x, jnp.int32(1), jnp.float32(0.8),
+                         jnp.int32(seq), jax.random.PRNGKey(r), None)
+                np.asarray(out)
+                times.append(time.time() - t0)
+            best = min(times)
+            tokens = (seq - 1) * tps * batch
+            print(json.dumps({
+                "batch": batch, "seq": seq, "compile_s": round(compile_s, 1),
+                "wall_s": round(best, 3),
+                "ms_per_token": round(best / ((seq - 1) * tps) * 1e3, 3),
+                "tokens_per_sec_aggregate": round(tokens / best, 1)}),
+                flush=True)
+        except Exception as e:
+            print(json.dumps({"batch": batch, "error": repr(e)[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

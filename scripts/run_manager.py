@@ -21,6 +21,7 @@ import random
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -32,9 +33,20 @@ def sh(cmd: str) -> subprocess.CompletedProcess:
 class Manager:
     def __init__(self, args):
         self.args = args
-        self.log = open(os.path.join(args.model_path, "run.log"), "a") \
-            if args.model_path else sys.stderr
-        os.makedirs(args.model_path, exist_ok=True) if args.model_path else None
+        # manager log lives with the run artifacts — through the fs seam so
+        # remote model_paths (gs://...) work like the reference's GFile log
+        # adapter (reference run_manager.py:26-56).  The training
+        # subprocess's stdout needs a real fd, so remote paths tee it to a
+        # local spool file instead.
+        from homebrewnlp_tpu.utils import fs
+        if not args.model_path:
+            self.log = sys.stderr
+        elif fs.is_local(args.model_path):
+            os.makedirs(args.model_path, exist_ok=True)
+            self.log = open(os.path.join(args.model_path, "run.log"), "a")
+        else:
+            fs.makedirs(args.model_path)
+            self.log = fs.open_(fs.join(args.model_path, "run.log"), "a")
 
     def out(self, msg: str):
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
@@ -78,11 +90,37 @@ class Manager:
             return 0.0
         return time.time() - os.path.getmtime(path)
 
+    _spool_path = None
+    _spool = None
+
     def launch(self) -> subprocess.Popen:
         self.out(f"launching: {self.args.run_command}")
+        if hasattr(self.log, "fileno"):
+            sink = self.log
+        else:
+            # remote run.log has no fd for subprocess redirection: spool
+            # locally, then upload_spool() appends it remotely on every poll
+            # tick / restart so crash tracebacks survive VM preemption
+            self.upload_spool()
+            if self._spool is not None:
+                self._spool.close()
+            self._spool_path = os.path.join(
+                tempfile.gettempdir(), f"run_manager_spool_{os.getpid()}.log")
+            self._spool = sink = open(self._spool_path, "w")
         return subprocess.Popen(self.args.run_command, shell=True,
-                                stdout=self.log, stderr=self.log,
+                                stdout=sink, stderr=sink,
                                 preexec_fn=os.setsid)
+
+    def upload_spool(self):
+        """Append spooled subprocess output to the remote run.log."""
+        if self._spool_path is None or not os.path.exists(self._spool_path):
+            return
+        with open(self._spool_path) as f:
+            data = f.read()
+        if data:
+            self.log.write(data)
+            self.log.flush()
+        open(self._spool_path, "w").close()  # consumed
 
     def kill(self, proc: subprocess.Popen):
         try:
@@ -99,6 +137,7 @@ class Manager:
         while True:
             time.sleep(self.args.poll_interval
                        + random.randint(0, self.args.poll_jitter))
+            self.upload_spool()
             healthy = self.tpu_healthy()
             stalled = (self.args.stall_timeout > 0
                        and self.heartbeat_age() > self.args.stall_timeout)
@@ -119,6 +158,7 @@ class Manager:
             time.sleep(60)
             self.create_tpu(recreate=not healthy)
             proc = self.launch()
+        self.upload_spool()
         if self.args.delete_cmd:
             self.out("deleting TPU")
             sh(self.args.delete_cmd)
