@@ -102,6 +102,9 @@ class ModelParameter:
         self.storage_dtype = "float32"
         self.slice_dtype = "float32"
         self.calculation_dtype = "float32"
+        # storage dtype for decode-time KV caches (None = calculation dtype);
+        # the cache dominates decode HBM at wide batch — see BASELINE.md
+        self.decode_cache_dtype = None
         self.optimizer_slice_dtype = "float32"
         self.optimizer_calculation_dtype = "float32"
         self.learning_rate_config: typing.Dict[str, typing.Any] = {}
@@ -149,6 +152,16 @@ class ModelParameter:
         # capacity_factor<f> on the routed mixture_of_experts override these
         self.moe_top_k = 1
         self.moe_capacity_factor = 1.25
+        # Switch/GShard auxiliary losses on the routed MoE router (0 = off,
+        # the reference-parity default — the reference's soft-MoE has no
+        # router).  Gradients are injected via a custom_vjp on the router
+        # logits so they are exact under every memory strategy; the reported
+        # total loss stays the task loss (see model/basic.py).
+        self.moe_balance_loss = 0.0
+        self.moe_router_z_loss = 0.0
+        # every N steps, run a forward-only routing probe and merge per-layer
+        # expert utilization / dropped-token stats into the step metrics
+        self.moe_metrics_interval = 0
         self.pkm_axes = 2
         self.use_bit_fold_input_pipeline = False
         self.bit_fold_value = 4
@@ -187,6 +200,10 @@ class ModelParameter:
         # stage — O(stages) activation stash instead of O(microbatches)
         # (parallel/pipeline_1f1b.py; text models, linear loss only)
         self.pipeline_schedule = "gpipe"
+        # virtual chunks per 1f1b stage (Megatron-style interleaving): each
+        # device holds V non-adjacent layer chunks, shrinking the pipeline
+        # bubble ~1/V for V× more ring hops.  1 = classic non-interleaved.
+        self.pipeline_interleave = 1
         # lax.scan over depth: O(1) program size + bounded live activations
         # (falls back to unrolled blocks when the stack isn't homogeneous)
         self.scan_layers = True
@@ -216,7 +233,8 @@ class ModelParameter:
             self.empty_frame_embedding = self.empty_frame_embedding.split('-')
 
         for attr in ("slice_dtype", "storage_dtype", "calculation_dtype",
-                     "optimizer_slice_dtype", "optimizer_calculation_dtype"):
+                     "optimizer_slice_dtype", "optimizer_calculation_dtype",
+                     "decode_cache_dtype"):
             v = getattr(self, attr)
             if isinstance(v, str):
                 setattr(self, attr, _DTYPES[v])
@@ -239,6 +257,12 @@ class ModelParameter:
         if self.multi_loss_strategy not in ("linear", "pcgrad", "mgda"):
             print(f"{self.multi_loss_strategy} unsupported; defaulting to linear")
             self.multi_loss_strategy = "linear"
+        if ((self.moe_balance_loss or self.moe_router_z_loss)
+                and self.multi_loss_strategy != "linear"):
+            # the router aux gradients are injected once per backward pass;
+            # pcgrad/mgda run one backward PER loss and would count them twice
+            raise ValueError("moe_balance_loss/moe_router_z_loss require "
+                             "multi_loss_strategy='linear'")
         if not self.use_language and not self.use_video:
             raise ValueError("Language and video mode are disabled. No model can be built.")
         if self.weight_standardisation and not self.weight_centralisation:
@@ -312,6 +336,22 @@ class ModelParameter:
                 f"depth={self.depth} must divide into pipe={self.pipeline_stages} stages")
         if self.pipeline_microbatches is None:
             self.pipeline_microbatches = self.pipeline_stages
+        self.pipeline_interleave = max(1, int(self.pipeline_interleave or 1))
+        if self.pipeline_interleave > 1:
+            if self.pipeline_schedule != "1f1b":
+                raise ValueError("pipeline_interleave > 1 requires "
+                                 "pipeline_schedule='1f1b'")
+            chunks = self.pipeline_stages * self.pipeline_interleave
+            if self.pipeline_stages > 1 and self.depth % chunks:
+                raise ValueError(
+                    f"depth={self.depth} must divide into "
+                    f"{chunks} virtual chunks "
+                    f"(pipe={self.pipeline_stages} x "
+                    f"interleave={self.pipeline_interleave})")
+            if self.pipeline_microbatches % self.pipeline_stages:
+                raise ValueError("interleaved 1f1b needs "
+                                 "pipeline_microbatches divisible by "
+                                 "pipeline_stages")
         # dim-name -> mesh-axis layout rules ("batch:b,heads:h" analogue);
         # layout_override adds/replaces rules (e.g. {"experts": "model"} for
         # expert-parallel soft-MoE with replicated heads)

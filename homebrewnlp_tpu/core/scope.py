@@ -61,6 +61,11 @@ class Context:
         self.param_dims: typing.Dict[str, tuple] = {}
         # arbitrary cross-layer caches (shared-variable machinery etc.)
         self.cache: typing.Dict[str, typing.Any] = {}
+        # when not None, layers append (scope_path, {stat: scalar}) tuples
+        # (e.g. MoE routing stats).  Only set by forward-only probe passes
+        # where no lax.scan/custom_vjp separates the layer trace from the
+        # consumer — ReplayBlock propagates it into its per-block contexts.
+        self.stats_sink: typing.Optional[list] = None
         self._rng_count = 0
 
     # -- naming ------------------------------------------------------------

@@ -288,9 +288,11 @@ class Model:
     def apply(self, variables: typing.Dict[str, jax.Array],
               batch: typing.Dict[str, jax.Array],
               rng: typing.Optional[jax.Array] = None,
-              mesh: typing.Any = None) -> LossInfo:
+              mesh: typing.Any = None,
+              stats_sink: typing.Optional[list] = None) -> LossInfo:
         assert self.plan is not None, "call init() first (or assign .plan)"
         ctx = scope.Context("apply", params=variables, rng_key=rng, mesh=mesh)
+        ctx.stats_sink = stats_sink
         with scope.context(ctx):
             args = self._named_inputs(batch)
             self.params.attention_idx = 0
@@ -428,7 +430,8 @@ class Model:
         assert not p.use_video and p.use_language, \
             "incremental decode supports text (gpt) mode only"
         state = DecodeState(jnp.asarray(pos, jnp.int32), p.sequence_dim.size,
-                            p.sequence_dim.name, caches)
+                            p.sequence_dim.name, caches,
+                            cache_dtype=p.decode_cache_dtype)
         ctx = scope.Context("apply", params=variables, mesh=mesh, decode=state)
         decode_dims = [Dim(d.name, 1) if d.name == p.sequence_dim.name else d
                        for d in p.token_dim_shape]

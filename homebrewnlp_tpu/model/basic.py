@@ -8,6 +8,7 @@ memory, bottleneck_group_linear.
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -59,6 +60,77 @@ def mixture_of_experts(args: BlockArgs) -> NamedTensor:
                   output_shape=out_shape)
 
 
+def _topk_dispatch(probs, top_k: int, capacity: int):
+    """Vectorized GShard-style greedy top-k dispatch.
+
+    Equivalent to the sequential loop (iteration j: mask previous choices,
+    argmax, assign buffer positions): the k-major cumsum gives every token's
+    j-th choice a position behind ALL tokens' earlier choices, which is
+    exactly the order the loop fills expert buffers in.  Returns
+    (combine [g,t,E,C], idx [g,t,k], keep [g,k,t])."""
+    g, t, e = probs.shape
+    vals, idx = jax.lax.top_k(probs, top_k)            # [g, t, k]
+    oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [g, t, k, E]
+    oh_k = jnp.transpose(oh, (0, 2, 1, 3))             # [g, k, t, E]
+    oh_km = oh_k.reshape(g, top_k * t, e)              # k-major flatten
+    pos = jnp.cumsum(oh_km, axis=1) - oh_km            # earlier fills per E
+    pos_tok = jnp.sum(pos * oh_km, axis=-1).reshape(g, top_k, t)
+    keep = (pos_tok < capacity).astype(jnp.float32)    # [g, k, t]
+    gate_w = jnp.transpose(vals, (0, 2, 1))            # [g, k, t]
+    slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)           # [g, k, t, C]
+    combine = jnp.einsum("gkt,gkte,gktc->gtec", gate_w * keep, oh_k, slot,
+                         precision=jax.lax.Precision.HIGHEST)
+    return combine, idx, keep
+
+
+def _router_aux(wb: float, wz: float, top_k: int, logits):
+    """Switch/GShard auxiliary losses as a function of the router logits
+    alone: ``wb * E * mean_g sum_e f_e P_e`` (f_e = fraction of (token,
+    choice) pairs routed to expert e — constant w.r.t. logits, gradient
+    flows through the mean-probability term, as in Switch) plus
+    ``wz * mean logsumexp(logits)^2`` (router z-loss)."""
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    total = jnp.float32(0)
+    if wb:
+        _, idx = jax.lax.top_k(logits, top_k)
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [g, t, k, E]
+        frac = jnp.mean(jnp.sum(oh, axis=2), axis=1)       # [g, E], sums to k
+        mean_p = jnp.mean(probs, axis=1)                   # [g, E]
+        total = total + wb * e * jnp.mean(
+            jnp.sum(jax.lax.stop_gradient(frac) * mean_p, axis=-1)) / top_k
+    if wz:
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        total = total + wz * jnp.mean(lse ** 2)
+    return total
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _router_aux_inject(wb: float, wz: float, top_k: int, logits):
+    """Identity on the forward; the backward ADDS the auxiliary-loss gradient
+    to the logits cotangent.  Because the aux losses depend only on the
+    logits, this injects their exact gradient without the loss value ever
+    having to escape the block stack — which makes it correct under every
+    memory strategy (revnet/momentum custom_vjp replays, lax.scan over
+    depth, jax.checkpoint, 1F1B per-stage vjp) with zero changes to that
+    machinery.  The reported total loss stays the task loss; the aux VALUES
+    are observable through the routing-stats probe (Trainer.moe_stats)."""
+    return logits
+
+
+def _router_aux_fwd(wb, wz, top_k, logits):
+    return logits, logits
+
+
+def _router_aux_bwd(wb, wz, top_k, logits, ct):
+    aux_grad = jax.grad(lambda l: _router_aux(wb, wz, top_k, l))(logits)
+    return (ct + aux_grad.astype(ct.dtype),)
+
+
+_router_aux_inject.defvjp(_router_aux_fwd, _router_aux_bwd)
+
+
 def routed_mixture_of_experts(args: BlockArgs) -> NamedTensor:
     """Top-k routed MoE with capacity-bounded dense dispatch (GShard/Switch
     style) — NEW capability: the reference only has the dense soft-MoE above
@@ -105,27 +177,29 @@ def routed_mixture_of_experts(args: BlockArgs) -> NamedTensor:
     gate_t = transpose_to(gate, token_dims + [params.expert_dim])
     logits = gate_t.data.reshape(g_sz, t_sz, n_exp).astype(jnp.float32)
 
+    wb, wz = float(params.moe_balance_loss), float(params.moe_router_z_loss)
+    if params.train and (wb or wz):
+        logits = _router_aux_inject(wb, wz, top_k, logits)
     probs = jax.nn.softmax(logits, axis=-1)             # [g, t, E]
     capacity = max(1, int(math.ceil(top_k * t_sz / n_exp * capacity_factor)))
     capacity = min(capacity, t_sz)
 
-    combine = jnp.zeros((g_sz, t_sz, n_exp, capacity), jnp.float32)
-    used = jnp.zeros_like(probs)                        # masked-out choices
-    position_base = jnp.zeros((g_sz, n_exp), jnp.int32)
-    for _ in range(top_k):
-        masked = probs - used * 1e9
-        choice = jnp.argmax(masked, axis=-1)            # [g, t]
-        onehot = jax.nn.one_hot(choice, n_exp, dtype=jnp.float32)
-        # position of each token in its chosen expert's buffer
-        pos = jnp.cumsum(onehot, axis=1) - onehot + position_base[:, None, :]
-        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [g, t]
-        keep = (pos_tok < capacity).astype(jnp.float32)
-        gate_w = jnp.sum(probs * onehot, axis=-1)       # [g, t]
-        slot = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)
-        combine = combine + (gate_w * keep)[..., None, None] \
-            * onehot[..., None] * slot[:, :, None, :]
-        used = used + onehot
-        position_base = position_base + jnp.sum(onehot, axis=1).astype(jnp.int32)
+    combine, idx, keep = _topk_dispatch(probs, top_k, capacity)
+
+    sink = scope.current().stats_sink
+    if sink is not None:
+        oh = jax.nn.one_hot(idx, n_exp, dtype=jnp.float32)
+        frac = jnp.mean(jnp.sum(oh, axis=2), axis=(0, 1))    # [E], sums to k
+        util = frac * n_exp / top_k                # 1.0 = perfectly balanced
+        sink.append((scope.current().path(), {
+            "balance_loss": _router_aux(1.0, 0.0, top_k, logits),
+            "router_z_loss": _router_aux(0.0, 1.0, top_k, logits),
+            "dropped_fraction": 1.0 - jnp.mean(keep),
+            "utilization_min": jnp.min(util),
+            "utilization_max": jnp.max(util),
+            "utilization": util,
+        }))
+
     # renormalize the kept top-k gate mass (standard top-k softmax renorm)
     denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
     combine = combine / jnp.maximum(denom, 1e-9)

@@ -62,12 +62,15 @@ class ReplayBlock:
         outer_rng = None
         outer_mesh = None
         outer_decode = None
+        outer_sink = None
         if scope.in_context():
             outer_rng = scope.current().rng_key
             outer_mesh = scope.current().mesh
             outer_decode = scope.current().decode
+            outer_sink = scope.current().stats_sink
         ctx = scope.Context("apply", params=subset, rng_key=None,
                             mesh=outer_mesh, decode=outer_decode)
+        ctx.stats_sink = outer_sink
         if outer_rng is not None:
             # `it` is the (possibly traced) depth index under scan-over-layers
             idx = self.depth_idx if it is None else it
@@ -543,42 +546,54 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
 
     alpha = params.momentumnet_alpha
 
-    def step(carry, xs):
-        sl_params, sl_caches = xs
+    # the depth-stacked caches ride the scan CARRY (slice out iteration
+    # ``it``, dynamic-update-slice the result back) rather than xs/ys: the
+    # xs->ys form kept TWO full copies of every KV buffer live during the
+    # scan — the extra copy is what pushed flagship batch-32 decode out of
+    # HBM — while a carried buffer is aliased in place by XLA's loop
+    # optimizer.
+    def step(carry, sl_params):
+        *streams, it, caches = carry
+        sl_caches = {k: jax.lax.dynamic_index_in_dim(v, it, 0, keepdims=False)
+                     for k, v in caches.items()}
         sub = decode_mod.DecodeState(state.pos, state.seq_len, state.seq_name,
-                                     sl_caches)
+                                     sl_caches,
+                                     cache_dtype=state.cache_dtype)
         saved_decode = ctx.decode
         ctx.decode = sub
         try:
-            *streams, it = carry
             pairs = [(f, {**sl_params[c], **shared[c]})
                      for c, f in enumerate(fns)]
             streams = _forward_recurrence(strategy, alpha, pairs,
                                           tuple(streams), it=it)
-            new_carry = (*streams, it + 1)
         finally:
             ctx.decode = saved_decode
-        return new_carry, dict(sub.out)
+        new_caches = dict(caches)
+        for rel, arr in sub.out.items():
+            # the discovery pass defines every cache name before the scan
+            # runs; a cache born lazily inside the scan would be silently
+            # dropped from the carry (corrupting decode), so fail loudly
+            assert rel in rel_cache_names, (
+                f"decode cache {rel!r} created inside the scan body; it is "
+                f"not part of the sampler carry — the discovery-pass "
+                f"invariant is violated")
+            if arr is not sl_caches[rel]:  # untouched caches: no copy-back
+                new_caches[rel] = jax.lax.dynamic_update_slice_in_dim(
+                    caches[rel], arr[None].astype(caches[rel].dtype), it, 0)
+        return (*streams, it + 1, new_caches), None
 
-    carry0 = ((src, src, jnp.int32(0))
-              if strategy in ("revnet", "momentum") else (src, jnp.int32(0)))
-    carry, cache_updates = jax.lax.scan(step, carry0,
-                                        (stacked_params, stacked_caches))
-    for rel, arr in cache_updates.items():
-        # the discovery pass defines every cache name before the scan runs;
-        # a cache born lazily inside the scan would be silently dropped from
-        # the carry (corrupting decode), so fail loudly instead
-        assert rel in rel_cache_names, (
-            f"decode cache {rel!r} created inside the scan body; it is not "
-            f"part of the sampler carry — the discovery-pass invariant is "
-            f"violated")
+    carry0 = ((src, src, jnp.int32(0), stacked_caches)
+              if strategy in ("revnet", "momentum")
+              else (src, jnp.int32(0), stacked_caches))
+    carry, _ = jax.lax.scan(step, carry0, stacked_params)
+    *streams, _, final_caches = carry
+    for rel, arr in final_caches.items():
         if stacked_in:
-            # scan ys are already depth-stacked: write back verbatim
+            # the sampler carries caches depth-stacked: write back verbatim
             state.out[STACKED_CACHE_PREFIX + rel] = arr
         else:
             state.out.update(unstack_decode_caches(
                 params, {STACKED_CACHE_PREFIX + rel: arr}))
-    *streams, _ = carry
     return sum(streams[1:], streams[0])
 
 
@@ -634,6 +649,17 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
                                        attn_base)
             if scanned is not None:
                 return scanned, plan
+        carry = ((src, src) if strategy in ("revnet", "momentum")
+                 else (src,))
+        streams = _forward_recurrence(strategy, params.momentumnet_alpha,
+                                      zip(fns, subsets), carry)
+        return sum(streams[1:], streams[0]), plan
+
+    if ctx.stats_sink is not None:
+        # forward-only stats probe: run the strategy-faithful recurrence as a
+        # plain python loop (identical values to the trained forward) so
+        # layer stats appended to the sink stay at the consumer's trace
+        # level — lax.scan / custom_vjp would strand them in a sub-trace
         carry = ((src, src) if strategy in ("revnet", "momentum")
                  else (src,))
         streams = _forward_recurrence(strategy, params.momentumnet_alpha,

@@ -39,11 +39,17 @@ class DecodeState:
     """Carried through one decode step: position + cache pytree in/out."""
 
     def __init__(self, pos: jax.Array, seq_len: int, seq_name: str,
-                 caches: typing.Dict[str, jax.Array]):
+                 caches: typing.Dict[str, jax.Array],
+                 cache_dtype: typing.Any = None):
         self.pos = pos
         self.seq_len = seq_len
         self.seq_name = seq_name
         self.caches = caches
+        # storage dtype override for the full-length KV buffers (config
+        # ``decode_cache_dtype``); None keeps the calculation dtype.  The
+        # KV cache dominates decode HBM at wide batch (BASELINE.md
+        # 'Decoding'), so f32-calc configs can halve it with bfloat16 here.
+        self.cache_dtype = cache_dtype
         self.out: typing.Dict[str, jax.Array] = dict(caches)
 
 
@@ -90,10 +96,12 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     name = "cache/" + ctx.full_name("kv")
     axis = x.axis(dim)
     full_dims = [key_dim_for(state, d) if d == dim else d for d in x.dims]
-    buf = _cache(name, [d.size for d in full_dims], x.dtype)
-    buf = jax.lax.dynamic_update_slice_in_dim(buf, x.data, state.pos, axis)
+    store_dtype = state.cache_dtype or x.dtype
+    buf = _cache(name, [d.size for d in full_dims], store_dtype)
+    buf = jax.lax.dynamic_update_slice_in_dim(
+        buf, x.data.astype(store_dtype), state.pos, axis)
     state.out[name] = buf
-    return nt(buf, full_dims)
+    return nt(buf.astype(x.dtype), full_dims)
 
 
 def running_sum(x: NamedTensor) -> NamedTensor:

@@ -56,7 +56,7 @@ def _norm_fwd(x, scale, shift, axes, eps, has_scale, has_shift):
     return y, (x, scale, shift, mu, inv)
 
 
-def _norm_bwd(axes, eps, has_scale, has_shift, res, dy):
+def _norm_bwd_xla(axes, eps, has_scale, has_shift, res, dy):
     x, scale, shift, mu, inv = res
     xf = x.astype(jnp.float32)
     dyf = dy.astype(jnp.float32)
@@ -78,6 +78,124 @@ def _norm_bwd(axes, eps, has_scale, has_shift, res, dy):
     else:
         dshift = jnp.zeros_like(shift)
     return dx, dscale, dshift
+
+
+# ---- one-pass pallas backward --------------------------------------------
+#
+# The XLA backward above performs two reductions along the FEATURE axes
+# (m1, m2 — row reductions) and two along the BATCH axes (dscale, dshift —
+# column reductions) over the same (x, dy) tensors.  XLA cannot multi-output
+# -fuse reductions over different dimension sets, so the step trace shows
+# separate HBM passes for each family — the "reduce fusions at 22%"
+# weight-gradient cost named in docs/PERFORMANCE.md.  This kernel streams
+# row blocks once: per-row statistics and dx in registers, the per-column
+# dscale/dshift accumulated across the sequential grid directly into their
+# (block-constant) output buffers — one read of x and dy, one write of dx.
+
+def _norm_bwd_kernel(x_ref, dy_ref, scale_ref, dx_ref, dsc_ref, dsh_ref, *,
+                     eps: float, has_scale: bool, has_shift: bool):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dsc_ref[...] = jnp.zeros_like(dsc_ref)
+        dsh_ref[...] = jnp.zeros_like(dsh_ref)
+
+    xf = x_ref[...].astype(jnp.float32)          # [block_r, H, F]
+    dyf = dy_ref[...].astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True) - mu * mu
+    inv = jax.lax.rsqrt(jnp.maximum(var, 0.0) + eps)
+    xhat = (xf - mu) * inv
+    g = dyf * scale_ref[...][None].astype(jnp.float32) if has_scale else dyf
+    m1 = jnp.mean(g, axis=-1, keepdims=True)
+    m2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = ((g - m1 - xhat * m2) * inv).astype(dx_ref.dtype)
+    if has_scale:
+        dsc_ref[...] += jnp.sum(dyf * xhat, axis=0)
+    if has_shift:
+        dsh_ref[...] += jnp.sum(dyf, axis=0)
+
+
+def _norm_bwd_pallas(axes, eps, has_scale, has_shift, res, dy,
+                     interpret: bool = False):
+    """One-pass fused backward.  Returns None when the layout doesn't fit the
+    kernel (caller falls back to the XLA path): needs trailing contiguous
+    reduce axes, lane-aligned features, and a row count divisible into
+    blocks.  Statistics are recomputed from x in VMEM (cheaper than reading
+    saved mu/inv from HBM)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x, scale, shift, mu, inv = res
+    nd = x.ndim
+    if axes != tuple(range(nd - len(axes), nd)):
+        return None  # reduce axes must be the trailing block
+    param = scale if has_scale else shift
+    lead = 0
+    while lead < nd and param.shape[lead] == 1:
+        lead += 1
+    if lead > nd - len(axes):
+        lead = nd - len(axes)
+    if (param.shape[lead:] != x.shape[lead:]
+            or (has_scale and has_shift and scale.shape != shift.shape)):
+        return None  # params must cover exactly the trailing dims
+    import math
+    rows = math.prod(x.shape[:lead])
+    h = math.prod(x.shape[lead:nd - len(axes)])
+    f = math.prod(x.shape[nd - len(axes):])
+    if f % 128 or rows < 2:
+        return None
+    block_r = 1
+    # ~2MB per f32 working array (x, dy, dx live simultaneously in VMEM)
+    for cand in (256, 128, 64, 32, 16, 8, 4, 2):
+        if rows % cand == 0 and cand * h * f * 4 <= 2 * 2 ** 20:
+            block_r = cand
+            break
+    else:
+        return None
+
+    x3 = x.reshape(rows, h, f)
+    dy3 = dy.reshape(rows, h, f)
+    scale2 = (scale if has_scale else shift).reshape(h, f)
+    kernel = functools.partial(_norm_bwd_kernel, eps=eps,
+                               has_scale=has_scale, has_shift=has_shift)
+    dx3, dsc, dsh = pl.pallas_call(
+        kernel,
+        grid=(rows // block_r,),
+        in_specs=[pl.BlockSpec((block_r, h, f), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((block_r, h, f), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((h, f), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((block_r, h, f), lambda i: (i, 0, 0)),
+                   # block-constant outputs persist across the sequential
+                   # grid: the kernel accumulates the column reductions
+                   pl.BlockSpec((h, f), lambda i: (0, 0)),
+                   pl.BlockSpec((h, f), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, h, f), x.dtype),
+                   jax.ShapeDtypeStruct((h, f), jnp.float32),
+                   jax.ShapeDtypeStruct((h, f), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x3, dy3, scale2)
+    dx = dx3.reshape(x.shape)
+    dscale = dsc.reshape(scale.shape).astype(scale.dtype) if has_scale \
+        else jnp.zeros_like(scale)
+    dshift = dsh.reshape(shift.shape).astype(shift.dtype) if has_shift \
+        else jnp.zeros_like(shift)
+    return dx, dscale, dshift
+
+
+def _norm_bwd(axes, eps, has_scale, has_shift, res, dy):
+    # TPU-only kernel (pallas.tpu compiler params): other backends (cpu,
+    # gpu) take the XLA path below
+    if (has_scale or has_shift) and jax.default_backend() == "tpu":
+        out = _norm_bwd_pallas(axes, eps, has_scale, has_shift, res, dy)
+        if out is not None:
+            return out
+    return _norm_bwd_xla(axes, eps, has_scale, has_shift, res, dy)
 
 
 _norm_core.defvjp(_norm_fwd, _norm_bwd)

@@ -35,65 +35,166 @@ from ..core.dims import Dim
 from ..core.tensor import NamedTensor, nt
 from .pipeline import AXIS, _stack_stages, _stage_layout
 
-Schedule = typing.Tuple[np.ndarray, np.ndarray]  # kinds, mbs: [ticks, S]
+# kinds, mbs, chunks: [ticks, S] int32 tables
+Schedule = typing.Tuple[np.ndarray, np.ndarray, np.ndarray]
 
 IDLE, FWD, BWD = 0, 1, 2
 
 
-def build_schedule(n_micro: int, n_stages: int) -> Schedule:
-    """Static non-interleaved 1F1B table.
+def _unit_order(n_micro: int, n_stages: int, n_chunks: int, stage: int
+                ) -> typing.List[typing.Tuple[str, int, int]]:
+    """Per-device unit firing ORDER (kind, microbatch, chunk).
 
-    Per stage: ``min(M, S - s)`` warmup forwards, then strict B/F
-    alternation, then the trailing backwards; each unit fires at the
-    earliest tick its dependency allows (fwd: prev stage's fwd done;
-    bwd: next stage's bwd done, or the own-stage fwd for the last stage).
-    """
-    M, S = n_micro, n_stages
-    seq = []
-    for s in range(S):
-        warm = min(M, S - s)
-        units = [("F", m) for m in range(warm)]
+    ``n_chunks == 1``: the classic non-interleaved 1F1B order (min(M, S-s)
+    warmup forwards, strict B/F alternation, trailing backwards).
+
+    ``n_chunks > 1``: the interleaved virtual-stage order (Megatron-LM PP
+    interleaving): device s owns chunks ``c*S + s``; forward unit j maps to
+    chunk ``(j mod S·V) div S`` and microbatch ``(j div S·V)·S + j mod S``
+    (microbatch groups of S cycle through the chunks), the backward sequence
+    mirrors it with chunks reversed, and the warmup is
+    ``(S - s - 1)·2 + (V - 1)·S`` units — shrinking the bubble by ~1/V at
+    the price of V× more ring hops."""
+    M, S, V = n_micro, n_stages, n_chunks
+    if V == 1:
+        warm = min(M, S - stage)
+        units = [("F", m, 0) for m in range(warm)]
         for m in range(M - warm):
-            units.append(("B", m))
-            units.append(("F", warm + m))
-        units.extend(("B", m) for m in range(M - warm, M))
-        seq.append(units)
+            units.append(("B", m, 0))
+            units.append(("F", warm + m, 0))
+        units.extend(("B", m, 0) for m in range(M - warm, M))
+        return units
+    if M % S:
+        raise ValueError(f"interleaved 1F1B needs microbatches ({M}) "
+                         f"divisible by stages ({S})")
 
-    fwd_done = [[-1] * S for _ in range(M)]   # tick the unit completed
-    bwd_done = [[-1] * S for _ in range(M)]
+    def fwd_unit(j):
+        return ("F", (j // (S * V)) * S + j % S, (j % (S * V)) // S)
+
+    def bwd_unit(j):
+        return ("B", (j // (S * V)) * S + j % S, V - 1 - (j % (S * V)) // S)
+
+    total = M * V
+    warm = min((S - stage - 1) * 2 + (V - 1) * S, total)
+    units = [fwd_unit(j) for j in range(warm)]
+    # steady state is F-then-B here (the first backward's own forward is the
+    # first steady unit on the last stage), unlike the B-first non-
+    # interleaved steady above whose warmup already covers it
+    for j in range(total - warm):
+        units.append(fwd_unit(warm + j))
+        units.append(bwd_unit(j))
+    units.extend(bwd_unit(j) for j in range(total - warm, total))
+    return units
+
+
+def build_schedule(n_micro: int, n_stages: int, n_chunks: int = 1) -> Schedule:
+    """Static 1F1B tick table (optionally interleaved over virtual chunks).
+
+    Each device fires its units in ``_unit_order`` at the earliest tick the
+    dataflow allows: F(m,c,s) needs F(m,c,s-1) — or F(m,c-1,S-1) ring-wrapped
+    when s==0, c>0; B(m,c,s) needs its own F plus B(m,c,s+1) — or
+    B(m,c+1,0) wrapped when s==S-1, c<V-1 (the loss head seeds B(m,V-1,S-1)).
+    """
+    M, S, V = n_micro, n_stages, n_chunks
+    seq = [_unit_order(M, S, V, s) for s in range(S)]
+
+    fwd_done = np.full((M, V, S), -1, np.int64)  # tick the unit completed
+    bwd_done = np.full((M, V, S), -1, np.int64)
     pos = [0] * S
-    kinds, mbs = [], []
+    kinds, mbs, chunks = [], [], []
     t = 0
     while any(pos[s] < len(seq[s]) for s in range(S)):
-        krow, mrow = [IDLE] * S, [0] * S
+        krow, mrow, crow = [IDLE] * S, [0] * S, [0] * S
         fired = False
         for s in range(S):
             if pos[s] >= len(seq[s]):
                 continue
-            kind, m = seq[s][pos[s]]
+            kind, m, c = seq[s][pos[s]]
+
+            def done(tbl, mm, cc, ss):
+                return tbl[mm, cc, ss] >= 0 and tbl[mm, cc, ss] < t
             if kind == "F":
-                ready = (s == 0 or (fwd_done[m][s - 1] >= 0
-                                    and fwd_done[m][s - 1] < t))
+                if s > 0:
+                    ready = done(fwd_done, m, c, s - 1)
+                else:
+                    ready = c == 0 or done(fwd_done, m, c - 1, S - 1)
             else:
-                own = fwd_done[m][s] >= 0 and fwd_done[m][s] < t
-                ready = own and (s == S - 1 or (bwd_done[m][s + 1] >= 0
-                                                and bwd_done[m][s + 1] < t))
+                ready = done(fwd_done, m, c, s)
+                if s < S - 1:
+                    ready = ready and done(bwd_done, m, c, s + 1)
+                elif c < V - 1:
+                    ready = ready and done(bwd_done, m, c + 1, 0)
             if ready:
                 krow[s] = FWD if kind == "F" else BWD
                 mrow[s] = m
-                (fwd_done if kind == "F" else bwd_done)[m][s] = t
+                crow[s] = c
+                (fwd_done if kind == "F" else bwd_done)[m, c, s] = t
                 pos[s] += 1
                 fired = True
         assert fired, "schedule deadlock"
         kinds.append(krow)
         mbs.append(mrow)
+        chunks.append(crow)
         t += 1
-    return np.asarray(kinds, np.int32), np.asarray(mbs, np.int32)
+    return (np.asarray(kinds, np.int32), np.asarray(mbs, np.int32),
+            np.asarray(chunks, np.int32))
 
 
 def bubble_ticks(kinds: np.ndarray) -> int:
     """Idle (stage, tick) cells across the schedule — the pipeline bubble."""
     return int((kinds == IDLE).sum())
+
+
+def _choose_slots(kinds: np.ndarray, mbs: np.ndarray, chunks: np.ndarray,
+                  n_stages: int, n_chunks: int) -> int:
+    """Smallest stash size P such that ``m mod P`` is collision-free among
+    the microbatches LIVE (activation arrived, backward pending) per
+    (stage, chunk).  Liveness runs from the ring ARRIVAL of the forward
+    activation (one tick after the upstream forward fired; own tick for
+    stage 0 chunk 0, which reads the raw input) to the tick of the own
+    backward.  Non-interleaved 1F1B provably fits ``S + 1``; the interleaved
+    warmup can hold more, so verify statically instead of hoping."""
+    ticks = kinds.shape[0]
+    S, V, M = n_stages, n_chunks, int(mbs.max()) + 1
+    fwd_tick = np.full((M, V, S), -1, np.int64)
+    bwd_tick = np.full((M, V, S), -1, np.int64)
+    for t in range(ticks):
+        for s in range(S):
+            m, c = int(mbs[t, s]), int(chunks[t, s])
+            if kinds[t, s] == FWD:
+                fwd_tick[m, c, s] = t
+            elif kinds[t, s] == BWD:
+                bwd_tick[m, c, s] = t
+    # liveness windows [arrival, backward] per (stage, chunk)
+    windows: dict = {}
+    for m in range(M):
+        for c in range(V):
+            for s in range(S):
+                if fwd_tick[m, c, s] < 0:
+                    continue
+                if s > 0:
+                    arrive = fwd_tick[m, c, s - 1] + 1
+                elif c > 0:
+                    arrive = fwd_tick[m, c - 1, S - 1] + 1
+                else:
+                    arrive = fwd_tick[m, c, s]
+                windows.setdefault((s, c), []).append(
+                    (m, arrive, bwd_tick[m, c, s]))
+    for p in range(S + 1, S * V + V + 3):
+        ok = True
+        for wins in windows.values():
+            for i, (m1, a1, b1) in enumerate(wins):
+                for m2, a2, b2 in wins[i + 1:]:
+                    if m1 % p == m2 % p and a1 <= b2 and a2 <= b1:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if not ok:
+                break
+        if ok:
+            return p
+    raise AssertionError("no collision-free stash size found")
 
 
 def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
@@ -112,6 +213,7 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
     from ..core import scope
 
     n_stages = mesh.shape[AXIS]
+    n_virtual = max(1, int(getattr(params, "pipeline_interleave", 1) or 1))
     n_micro = max(1, int(params.pipeline_microbatches or n_stages))
     batch = src.dims[0]
     if batch.size % n_micro:
@@ -121,28 +223,46 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
     if mb % mesh.shape.get("data", 1):
         raise ValueError(f"microbatch {mb} not divisible by data parallelism")
 
-    stage0_fns, name_lists, stage_leaves = _stage_layout(fns, subsets, plan,
-                                                         n_stages)
-    stacked = _stack_stages(stage_leaves)
-    kinds_np, mbs_np = build_schedule(n_micro, n_stages)
+    # chunk g = c * S + s lives on device s as its c-th virtual chunk
+    # (Megatron-style round-robin), so the ring hop s -> s+1 stays
+    # chunk-preserving and the wrap S-1 -> 0 advances the chunk
+    stage0_fns, name_lists, chunk_leaves = _stage_layout(
+        fns, subsets, plan, n_stages * n_virtual)
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[_stack_stages([chunk_leaves[c * n_stages + s]
+                         for s in range(n_stages)])
+          for c in range(n_virtual)])              # leaves [V, S, ...]
+    kinds_np, mbs_np, chunks_np = build_schedule(n_micro, n_stages, n_virtual)
     ticks = kinds_np.shape[0]
-    stash_slots = n_stages + 1
+    stash_slots = _choose_slots(kinds_np, mbs_np, chunks_np, n_stages,
+                                n_virtual)
     # a unit may fire LATER than one tick after its payload arrives (stages
-    # interleave B units), so receives are filed into per-microbatch slot
-    # buffers via static store tables instead of being consumed off the ring
-    # directly: f_store[t, s] = slot to store this tick's incoming forward
-    # activation (the payload stage s-1 sent at t-1), -1 = nothing arriving
+    # interleave B units), so receives are filed into per-(chunk, microbatch)
+    # slot buffers via static store tables instead of being consumed off the
+    # ring directly: f_store[t, s] = flattened (chunk, slot) index to store
+    # this tick's incoming forward activation, -1 = nothing arriving.  The
+    # wrap hops (only live when interleaving) file into the NEXT chunk
+    # forward / the PREVIOUS chunk backward.
     f_store_np = np.full((ticks, n_stages), -1, np.int32)
     b_store_np = np.full((ticks, n_stages), -1, np.int32)
     for t in range(1, ticks):
-        for s in range(1, n_stages):
-            if kinds_np[t - 1, s - 1] == FWD:
-                f_store_np[t, s] = mbs_np[t - 1, s - 1] % stash_slots
-        for s in range(n_stages - 1):
-            if kinds_np[t - 1, s + 1] == BWD:
-                b_store_np[t, s] = mbs_np[t - 1, s + 1] % stash_slots
+        for s in range(n_stages):
+            prev = s - 1 if s > 0 else (n_stages - 1 if n_virtual > 1 else None)
+            if prev is not None and kinds_np[t - 1, prev] == FWD:
+                cs = chunks_np[t - 1, prev] + (0 if s > 0 else 1)
+                if cs < n_virtual:
+                    f_store_np[t, s] = (cs * stash_slots
+                                        + mbs_np[t - 1, prev] % stash_slots)
+            nxt = s + 1 if s < n_stages - 1 else (0 if n_virtual > 1 else None)
+            if nxt is not None and kinds_np[t - 1, nxt] == BWD:
+                cs = chunks_np[t - 1, nxt] - (0 if s < n_stages - 1 else 1)
+                if cs >= 0:
+                    b_store_np[t, s] = (cs * stash_slots
+                                        + mbs_np[t - 1, nxt] % stash_slots)
     kinds = jnp.asarray(kinds_np)
     mbs = jnp.asarray(mbs_np)
+    chunk_rows = jnp.asarray(chunks_np)
     f_store = jnp.asarray(f_store_np)
     b_store = jnp.asarray(b_store_np)
 
@@ -176,20 +296,27 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
 
     def body(stacked_local, head_p, xm_local, tgt_local):
         stage = jax.lax.axis_index(AXIS)
-        local = jax.tree.map(lambda a: jnp.squeeze(a, 0), stacked_local)
+        # leaves arrive [V, 1, ...] (chunk axis unsharded, stage axis local)
+        local = jax.tree.map(lambda a: jnp.squeeze(a, 1), stacked_local)
         is_last = stage == n_stages - 1
 
-        def with_rng(m, fn, *args):
+        def chunk_params(c):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0,
+                                                       keepdims=False), local)
+
+        def with_rng(m, c, fn, *args):
             if ctx is None or base_rng is None:
                 return fn(*args)
             # reset BOTH the folded key and the draw counter: the backward
             # unit's vjp re-trace must consume identical next_rng() draws as
             # the forward unit that produced the activation (the counter is
             # Python trace state and would otherwise keep counting across
-            # units, giving the recompute different dropout masks)
+            # units, giving the recompute different dropout masks).  The key
+            # folds the GLOBAL chunk index (== stage when not interleaved).
             saved_count = ctx._rng_count
             ctx.rng_key = jax.random.fold_in(
-                jax.random.fold_in(base_rng, stage), m)
+                jax.random.fold_in(base_rng, c * n_stages + stage), m)
             ctx._rng_count = 0
             try:
                 return fn(*args)
@@ -199,16 +326,19 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
 
         state_shape = (n_stream, mb) + xm_local.shape[2:]
         dtype = xm_local.dtype
+        n_slots_total = n_virtual * stash_slots
 
         def tick(carry, sched_row):
             (f_recv, b_recv, stash, bstash, grads, hgrads, loss_acc, aux_acc,
              d_src_acc) = carry
-            krow, mrow, frow, brow = sched_row
+            krow, mrow, crow, frow, brow = sched_row
             code = jnp.take(krow, stage)
             m = jnp.take(mrow, stage)
-            slot = jnp.mod(m, stash_slots)
+            c = jnp.take(crow, stage)
+            slot = c * stash_slots + jnp.mod(m, stash_slots)
+            params_c = chunk_params(c)
 
-            # file this tick's ring arrivals into their microbatch slots
+            # file this tick's ring arrivals into their (chunk, mb) slots
             fslot = jnp.take(frow, stage)
             stash = jax.lax.cond(
                 fslot >= 0,
@@ -227,14 +357,19 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
             state0 = jnp.broadcast_to(x0[None], state_shape).astype(dtype)
             stashed = jax.lax.dynamic_index_in_dim(stash, slot, 0,
                                                    keepdims=False)
-            x_in = jnp.where(stage == 0, state0, stashed)
+            # only the pipeline entry (stage 0, chunk 0) reads the raw input;
+            # later chunks on stage 0 read the wrap arrival from the stash
+            x_in = jnp.where((stage == 0) & (c == 0), state0, stashed)
+
+            def zero_like_grads():
+                return (jax.tree.map(jnp.zeros_like, grads),
+                        jax.tree.map(jnp.zeros_like, hgrads))
 
             def fwd_unit(_):
-                y = with_rng(m, stage_apply, local, x_in)
+                y = with_rng(m, c, stage_apply, params_c, x_in)
                 new_stash = jax.lax.dynamic_update_index_in_dim(
                     stash, x_in, slot, 0)
-                zg = jax.tree.map(jnp.zeros_like, grads)
-                zh = jax.tree.map(jnp.zeros_like, hgrads)
+                zg, zh = zero_like_grads()
                 return (y, new_stash, zg, zh, jnp.float32(0),
                         jnp.zeros((n_aux,), jnp.float32),
                         jnp.zeros_like(x0), jnp.zeros(state_shape, dtype),
@@ -253,8 +388,8 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
 
                 def run_last():
                     loss, vjp, aux = with_rng(
-                        m, lambda: jax.vjp(last_loss, local, xs, head_p,
-                                           has_aux=True))
+                        m, c, lambda: jax.vjp(last_loss, params_c, xs, head_p,
+                                              has_aux=True))
                     # the overall loss is the MEAN over microbatches: seed
                     # each microbatch's backward with 1/M
                     dparams, dx, dh = vjp(jnp.asarray(1.0 / n_micro,
@@ -267,21 +402,27 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
                     cot = jax.lax.dynamic_index_in_dim(bstash, slot, 0,
                                                        keepdims=False)
                     _, vjp = with_rng(
-                        m, lambda: jax.vjp(stage_apply, local, xs))
+                        m, c, lambda: jax.vjp(stage_apply, params_c, xs))
                     dparams, dx = vjp(cot)
                     return (dparams, jax.tree.map(jnp.zeros_like, hgrads),
                             dx, jnp.float32(0),
                             jnp.zeros((n_aux,), jnp.float32))
 
+                # the loss head hangs off the LAST chunk of the last stage
                 dparams, dh, dx, loss, aux = jax.lax.cond(
-                    is_last, run_last, run_mid)
-                d_src = jnp.where(stage == 0, dx.sum(0), jnp.zeros_like(x0))
-                return (jnp.zeros(state_shape, dtype), stash, dparams, dh,
+                    is_last & (c == n_virtual - 1), run_last, run_mid)
+                # scatter this chunk's param grads into the [V, ...] slot
+                dg = jax.tree.map(
+                    lambda z, d: jax.lax.dynamic_update_index_in_dim(
+                        z, d, c, 0),
+                    jax.tree.map(jnp.zeros_like, grads), dparams)
+                d_src = jnp.where((stage == 0) & (c == 0), dx.sum(0),
+                                  jnp.zeros_like(x0))
+                return (jnp.zeros(state_shape, dtype), stash, dg, dh,
                         loss, aux, d_src, dx, jnp.int32(1))
 
             def idle_unit(_):
-                zg = jax.tree.map(jnp.zeros_like, grads)
-                zh = jax.tree.map(jnp.zeros_like, hgrads)
+                zg, zh = zero_like_grads()
                 return (jnp.zeros(state_shape, dtype), stash, zg, zh,
                         jnp.float32(0), jnp.zeros((n_aux,), jnp.float32),
                         jnp.zeros_like(x0), jnp.zeros(state_shape, dtype),
@@ -296,21 +437,22 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
             aux_acc = aux_acc + daux
             prev = jax.lax.dynamic_index_in_dim(
                 d_src_acc, jnp.minimum(m, n_micro - 1), 0, keepdims=False)
+            # stage 0 fires B(m, c) for every chunk; only c == 0 carries the
+            # real input cotangent and it fires LAST for its microbatch
+            # (chunks unwind V-1 .. 0), so chunk>0 zero-writes land first
             d_src_acc = jax.lax.dynamic_update_index_in_dim(
                 d_src_acc, jnp.where(wrote > 0, d_src, prev),
                 jnp.minimum(m, n_micro - 1), 0)
-            f_recv = jax.lax.ppermute(
-                send_f, AXIS, [(i, i + 1) for i in range(n_stages - 1)])
-            b_recv = jax.lax.ppermute(
-                send_b, AXIS, [(i + 1, i) for i in range(n_stages - 1)])
+            f_recv = jax.lax.ppermute(send_f, AXIS, fwd_links)
+            b_recv = jax.lax.ppermute(send_b, AXIS, bwd_links)
             return (f_recv, b_recv, stash, bstash, grads, hgrads, loss_acc,
                     aux_acc, d_src_acc), None
 
         carry0 = (
             jnp.zeros(state_shape, dtype),
             jnp.zeros(state_shape, dtype),
-            jnp.zeros((stash_slots,) + state_shape, dtype),
-            jnp.zeros((stash_slots,) + state_shape, dtype),
+            jnp.zeros((n_slots_total,) + state_shape, dtype),
+            jnp.zeros((n_slots_total,) + state_shape, dtype),
             jax.tree.map(jnp.zeros_like, local),
             jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), head_p),
             jnp.float32(0),
@@ -318,18 +460,23 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
             jnp.zeros((n_micro,) + xm_local.shape[1:], xm_local.dtype),
         )
         (_, _, _, _, grads, hgrads, loss_acc, aux_acc, d_src_acc), _ = \
-            jax.lax.scan(tick, carry0, (kinds, mbs, f_store, b_store))
-        # grads live on their own stage; restore the leading stage axis for
-        # the out_spec.  head/loss/d_src live on single stages: psum over
-        # pipe replicates them.
-        grads = jax.tree.map(lambda a: a[None], grads)
+            jax.lax.scan(tick, carry0,
+                         (kinds, mbs, chunk_rows, f_store, b_store))
+        # grads live on their own stage; restore the stage axis for the
+        # out_spec.  head/loss/d_src live on single stages: psum over pipe
+        # replicates them.
+        grads = jax.tree.map(lambda a: a[:, None], grads)
         hgrads = jax.tree.map(lambda a: jax.lax.psum(a, AXIS), hgrads)
         loss_acc = jax.lax.psum(loss_acc, AXIS) / n_micro
         aux_acc = jax.lax.psum(aux_acc, AXIS) / n_micro
         d_src_acc = jax.lax.psum(d_src_acc, AXIS)
         return grads, hgrads, loss_acc, aux_acc, d_src_acc
 
-    param_specs = jax.tree.map(lambda _: P(AXIS), stacked)
+    fwd_links = [(i, i + 1) for i in range(n_stages - 1)] \
+        + ([(n_stages - 1, 0)] if n_virtual > 1 else [])
+    bwd_links = [(i + 1, i) for i in range(n_stages - 1)] \
+        + ([(0, n_stages - 1)] if n_virtual > 1 else [])
+    param_specs = jax.tree.map(lambda _: P(None, AXIS), stacked)
     head_specs = jax.tree.map(lambda _: P(), head_params)
     fn = jax.shard_map(
         body, mesh=mesh,
@@ -346,15 +493,17 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
         if ctx is not None:
             ctx.mesh = saved_mesh
 
-    # stage-stacked grads -> flat names (shared weights sum across blocks)
+    # chunk/stage-stacked grads -> flat names (shared weights sum across
+    # blocks); global chunk c*S + s holds blocks (c*S + s)*per_chunk + k
     flat: typing.Dict[str, jax.Array] = {}
-    per_stage = len(fns) // n_stages
-    for s in range(n_stages):
-        for k_local in range(per_stage):
-            k = s * per_stage + k_local
-            names = tuple(plan[k][2])
-            for name, g in zip(names, grads[k_local]):
-                gs = g[s]
-                flat[name] = flat.get(name, 0) + gs
+    per_chunk = len(fns) // (n_stages * n_virtual)
+    for c in range(n_virtual):
+        for s in range(n_stages):
+            for k_local in range(per_chunk):
+                k = (c * n_stages + s) * per_chunk + k_local
+                names = tuple(plan[k][2])
+                for name, g in zip(names, grads[k_local]):
+                    gs = g[c, s]
+                    flat[name] = flat.get(name, 0) + gs
     d_src_nt = nt(d_src.reshape(src.data.shape), src.dims)
     return loss, aux, flat, hgrads, d_src_nt

@@ -112,7 +112,9 @@ def web_api_mode(params: ModelParameter, args):
     params, model, variables = _load_model(params)
     interface = InterfaceWrapper(params, model, variables)
     from ..infer.rest_api import serve
-    serve(params, interface, workers=getattr(args, "workers", 1))
+    # reference: web_workers uvicorn processes (src/rest_api.py:84-87);
+    # main.py has already folded CLI --workers into params.web_workers
+    serve(params, interface, workers=params.web_workers)
 
 
 def debug_mode(params: ModelParameter, args):

@@ -205,6 +205,15 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 batch = next(data_it)
             except StopIteration:
                 break
+            if params.moe_metrics_interval and \
+                    step_now % params.moe_metrics_interval < params.macro_batching:
+                # forward-only routing probe (Trainer.moe_stats); scalars
+                # merge into the step metrics under moe/<layer path>/<stat>
+                metrics = dict(metrics)
+                for path, stats in trainer.moe_stats(state, batch).items():
+                    metrics.update({f"moe/{path}/{s}": v
+                                    for s, v in stats.items()
+                                    if np.ndim(v) == 0})
             if step_now % log_every < params.macro_batching:
                 last_metrics = {k: float(v) for k, v in metrics.items()}
                 if logger is not None:

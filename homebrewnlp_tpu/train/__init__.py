@@ -70,6 +70,7 @@ class Trainer:
         self.mesh = mesh
         self.optimizer: typing.Optional[Optimizer] = None
         self._step_fn = None
+        self._stats_fn = None
         self._rng_counter = 0
 
     # -- state -------------------------------------------------------------
@@ -229,3 +230,36 @@ class Trainer:
         if self.mesh is not None:
             batch = shardlib.shard_batch(self.params, batch, self.mesh)
         return self._step_fn(state, batch, rng)
+
+    def moe_stats(self, state: TrainState, batch: typing.Dict[str, jax.Array],
+                  rng: typing.Optional[jax.Array] = None
+                  ) -> typing.Dict[str, typing.Dict[str, jax.Array]]:
+        """Per-layer MoE routing statistics: {scope_path: {stat: value}} with
+        expert utilization (1.0 = balanced), dropped-token fraction, and the
+        balance/z-loss values (observable here because the training step only
+        injects their GRADIENTS — model/basic.py:_router_aux_inject).
+
+        Runs a forward-only probe whose block recurrence is the strategy-
+        faithful python loop (identical activations to the trained forward;
+        run_body_blocks' stats path) so layer stats can legally flow out of
+        the trace.  Compiled once; intended for every-N-steps monitoring
+        (config ``moe_metrics_interval``)."""
+        p = self.params
+        if rng is None:
+            rng = jax.random.PRNGKey(p.current_step)
+        if self._stats_fn is None:
+            def stats_fn(variables, batch, rng):
+                if p.macro_batching > 1:  # probe the first micro slice
+                    batch = {k: v[0] for k, v in batch.items()}
+                sink: list = []
+                self.model.apply(variables, batch, rng, mesh=self.mesh,
+                                 stats_sink=sink)
+                out: typing.Dict[str, dict] = {}
+                for path, stats in sink:
+                    key = path if path not in out else f"{path}#{len(out)}"
+                    out[key] = stats
+                return out
+            self._stats_fn = jax.jit(stats_fn)
+        if self.mesh is not None:
+            batch = shardlib.shard_batch(p, batch, self.mesh)
+        return jax.device_get(self._stats_fn(state.variables, batch, rng))

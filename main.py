@@ -39,6 +39,8 @@ def main():
 
     params = ModelParameter(config)
     params.debug_gradients = args.debug_grad
+    # CLI --workers overrides the config (reference src/main.py:60)
+    params.web_workers = args.workers
     params.train = args.run_mode == "train"
     if not params.use_autoregressive_sampling and args.run_mode in ("sample",):
         print("use_autoregressive_sampling is off; enabling for sample mode")

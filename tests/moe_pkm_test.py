@@ -159,6 +159,90 @@ def routed_moe_flag_overrides_test():
     assert np.isfinite(float(m.apply(variables, batch).total_loss.data))
 
 
+def router_aux_inject_gradient_test():
+    """_router_aux_inject is identity forward; its backward adds exactly
+    jax.grad of the explicit aux losses to the incoming cotangent."""
+    from homebrewnlp_tpu.model.basic import _router_aux, _router_aux_inject
+    rng = np.random.default_rng(7)
+    logits = jnp.asarray(rng.standard_normal((2, 16, 4)), jnp.float32)
+    u = jnp.asarray(rng.standard_normal(logits.shape), jnp.float32)
+    wb, wz, k = 0.3, 0.01, 2
+
+    np.testing.assert_array_equal(
+        np.asarray(_router_aux_inject(wb, wz, k, logits)), np.asarray(logits))
+    g_inj = jax.grad(lambda l: jnp.sum(_router_aux_inject(wb, wz, k, l) * u)
+                     )(logits)
+    g_exp = u + jax.grad(lambda l: _router_aux(wb, wz, k, l))(logits)
+    np.testing.assert_allclose(np.asarray(g_inj), np.asarray(g_exp),
+                               rtol=1e-5, atol=1e-6)
+    # balanced-router fixed point: equal logits give balance loss 1.0
+    flat = jnp.zeros((1, 8, 4), jnp.float32)
+    np.testing.assert_allclose(float(_router_aux(1.0, 0.0, 1, flat)), 1.0,
+                               rtol=1e-6)
+
+
+def routed_moe_stats_probe_test():
+    """Trainer.moe_stats reports per-layer utilization / dropped fraction /
+    aux-loss values — through the scanned revnet stack (depth 2), where a
+    naive side-channel could never escape the lax.scan trace."""
+    params = make_params(
+        experts=4, heads=2, depth=2, moe_top_k=1, moe_capacity_factor=1.0,
+        block_config=[{"layer": ["norm-shift-scale-features-group",
+                                 ROUTED_LAYER]}])
+    m = Model(params)
+    rng = np.random.default_rng(5)
+    batch = _batch(params, rng)
+    tr = Trainer(params, m)
+    state = tr.init_state(batch)
+    stats = tr.moe_stats(state, batch)
+    assert len(stats) == 2, f"one stats entry per depth, got {list(stats)}"
+    for path, s in stats.items():
+        assert "block" in path, path
+        util = np.asarray(s["utilization"], np.float32)
+        assert util.shape == (4,)
+        np.testing.assert_allclose(util.sum(), 4.0, rtol=1e-5)
+        assert 0.0 <= float(s["dropped_fraction"]) <= 1.0
+        assert np.isfinite(float(s["balance_loss"]))
+        assert float(s["balance_loss"]) >= 1.0 - 1e-5  # E*sum(f*P)/k >= 1
+        assert np.isfinite(float(s["router_z_loss"]))
+        assert float(s["utilization_min"]) <= 1.0 <= float(s["utilization_max"]) + 1e-5
+
+
+def routed_moe_balance_loss_balances_router_test():
+    """Training WITH the balance loss drives the routers measurably closer
+    to the balanced fixed point (balance loss value 1.0) than the same run
+    without it, and reduces capacity drops (same seed, same data)."""
+    def run(balance):
+        params = make_params(
+            experts=4, heads=2, depth=2, moe_top_k=1, moe_capacity_factor=1.5,
+            moe_balance_loss=balance,
+            optimizer="learning_rate", learning_rate=0.05, weight_decay=0.0,
+            block_config=[{"layer": ["norm-shift-scale-features-group",
+                                     ROUTED_LAYER]}])
+        m = Model(params)
+        rng = np.random.default_rng(11)
+        tr = Trainer(params, m)
+        batch = _batch(params, rng)
+        state = tr.init_state(batch)
+        for i in range(80):
+            state, metrics = tr.step(state, _batch(params, rng),
+                                     jax.random.PRNGKey(i))
+        assert np.isfinite(float(metrics["loss"]))
+        stats = tr.moe_stats(state, batch, jax.random.PRNGKey(99))
+        bal = [float(s["balance_loss"]) for s in stats.values()]
+        dropped = [float(s["dropped_fraction"]) for s in stats.values()]
+        assert all(0.0 <= d <= 1.0 for d in dropped)
+        return sum(bal) / len(bal), max(dropped)
+
+    bal_off, dropped_off = run(0.0)
+    bal_on, dropped_on = run(1.0)
+    # balanced router == balance loss 1.0 (E * sum(f*P) with f=P=1/E)
+    assert bal_on < bal_off - 0.1, \
+        f"balance loss did not balance the router: {bal_on} vs {bal_off}"
+    assert bal_on < 1.3, f"router far from balance: {bal_on}"
+    assert dropped_on <= dropped_off + 0.05, (dropped_on, dropped_off)
+
+
 def routed_moe_expert_parallel_test():
     """Routed MoE with experts sharded over 'model' (the EP dryrun layout):
     the sharded step matches the unsharded step."""
