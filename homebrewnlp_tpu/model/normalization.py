@@ -88,21 +88,12 @@ def _norm_bwd_xla(axes, eps, has_scale, has_shift, res, dy):
 # -fuse reductions over different dimension sets, so the step trace shows
 # separate HBM passes for each family — the "reduce fusions at 22%"
 # weight-gradient cost named in docs/PERFORMANCE.md.  This kernel streams
-# row blocks once: per-row statistics and dx in registers, the per-column
-# dscale/dshift accumulated across the sequential grid directly into their
-# (block-constant) output buffers — one read of x and dy, one write of dx.
+# row blocks once on a PARALLEL grid: per-row statistics and dx in
+# registers, per-block dscale/dshift PARTIAL sums written to a [nb, H, F]
+# output and reduced outside the kernel.
 
 def _norm_bwd_kernel(x_ref, dy_ref, scale_ref, dx_ref, dsc_ref, dsh_ref, *,
                      eps: float, has_scale: bool, has_shift: bool):
-    from jax.experimental import pallas as pl
-
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        dsc_ref[...] = jnp.zeros_like(dsc_ref)
-        dsh_ref[...] = jnp.zeros_like(dsh_ref)
-
     xf = x_ref[...].astype(jnp.float32)          # [block_r, H, F]
     dyf = dy_ref[...].astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
@@ -113,10 +104,15 @@ def _norm_bwd_kernel(x_ref, dy_ref, scale_ref, dx_ref, dsc_ref, dsh_ref, *,
     m1 = jnp.mean(g, axis=-1, keepdims=True)
     m2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
     dx_ref[...] = ((g - m1 - xhat * m2) * inv).astype(dx_ref.dtype)
-    if has_scale:
-        dsc_ref[...] += jnp.sum(dyf * xhat, axis=0)
-    if has_shift:
-        dsh_ref[...] += jnp.sum(dyf, axis=0)
+    # per-block PARTIAL column sums (summed outside) keep the grid fully
+    # parallel.  NOTE: both this form and the earlier sequential
+    # accumulating grid measured the SAME 26.5k -> 20.1k tok/s regression on
+    # the flagship step — the cost is the kernel's fusion boundary, not the
+    # grid semantics (docs/PERFORMANCE.md round 3)
+    dsc_ref[...] = (jnp.sum(dyf * xhat, axis=0) if has_scale
+                    else jnp.zeros_like(dsc_ref))
+    dsh_ref[...] = (jnp.sum(dyf, axis=0) if has_shift
+                    else jnp.zeros_like(dsh_ref))
 
 
 def _norm_bwd_pallas(axes, eps, has_scale, has_shift, res, dy,
@@ -160,38 +156,46 @@ def _norm_bwd_pallas(axes, eps, has_scale, has_shift, res, dy,
     x3 = x.reshape(rows, h, f)
     dy3 = dy.reshape(rows, h, f)
     scale2 = (scale if has_scale else shift).reshape(h, f)
+    nb = rows // block_r
     kernel = functools.partial(_norm_bwd_kernel, eps=eps,
                                has_scale=has_scale, has_shift=has_shift)
     dx3, dsc, dsh = pl.pallas_call(
         kernel,
-        grid=(rows // block_r,),
+        grid=(nb,),
         in_specs=[pl.BlockSpec((block_r, h, f), lambda i: (i, 0, 0)),
                   pl.BlockSpec((block_r, h, f), lambda i: (i, 0, 0)),
                   pl.BlockSpec((h, f), lambda i: (0, 0))],
         out_specs=[pl.BlockSpec((block_r, h, f), lambda i: (i, 0, 0)),
-                   # block-constant outputs persist across the sequential
-                   # grid: the kernel accumulates the column reductions
-                   pl.BlockSpec((h, f), lambda i: (0, 0)),
-                   pl.BlockSpec((h, f), lambda i: (0, 0))],
+                   pl.BlockSpec((None, h, f), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((None, h, f), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((rows, h, f), x.dtype),
-                   jax.ShapeDtypeStruct((h, f), jnp.float32),
-                   jax.ShapeDtypeStruct((h, f), jnp.float32)],
+                   jax.ShapeDtypeStruct((nb, h, f), jnp.float32),
+                   jax.ShapeDtypeStruct((nb, h, f), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x3, dy3, scale2)
     dx = dx3.reshape(x.shape)
-    dscale = dsc.reshape(scale.shape).astype(scale.dtype) if has_scale \
+    dscale = dsc.sum(0).reshape(scale.shape).astype(scale.dtype) if has_scale \
         else jnp.zeros_like(scale)
-    dshift = dsh.reshape(shift.shape).astype(shift.dtype) if has_shift \
+    dshift = dsh.sum(0).reshape(shift.shape).astype(shift.dtype) if has_shift \
         else jnp.zeros_like(shift)
     return dx, dscale, dshift
 
 
+# The kernel is OFF by default: measured on the flagship 32big_mixer step it
+# REGRESSES 26.5k -> 20.1k tokens/sec (identical with sequential-accumulating
+# and fully-parallel grids).  The pallas call is an opaque fusion boundary:
+# XLA was already folding the norm-backward elementwise work into the
+# adjacent matmul/reduce fusions, and forcing x and dy through a standalone
+# kernel materialises ~0.5GB of bf16 operands per call that previously never
+# hit HBM as standalone tensors — costing more than the saved reduction
+# passes.  Kept (tested, numerics-pinned) for layouts where the fusion
+# context differs; enable with HBNLP_NORM_BWD_PALLAS=1.
 def _norm_bwd(axes, eps, has_scale, has_shift, res, dy):
-    # TPU-only kernel (pallas.tpu compiler params): other backends (cpu,
-    # gpu) take the XLA path below
-    if (has_scale or has_shift) and jax.default_backend() == "tpu":
+    import os
+    if ((has_scale or has_shift) and jax.default_backend() == "tpu"
+            and os.environ.get("HBNLP_NORM_BWD_PALLAS") == "1"):
         out = _norm_bwd_pallas(axes, eps, has_scale, has_shift, res, dy)
         if out is not None:
             return out

@@ -58,7 +58,10 @@ def pallas_backward_matches_xla_test():
     from homebrewnlp_tpu.model.normalization import (_norm_bwd_pallas,
                                                      _norm_bwd_xla)
     rng = np.random.default_rng(3)
-    x = jnp.asarray(rng.standard_normal((8, 4, 2, 128)) * 2 + 0.3,
+    # 1024 rows -> multiple grid blocks, so the per-block partial-sum
+    # outputs and the outside sum(0) are exercised (nb > 1), not just the
+    # degenerate single-block case
+    x = jnp.asarray(rng.standard_normal((128, 8, 2, 128)) * 2 + 0.3,
                     jnp.float32)
     dy = jnp.asarray(rng.standard_normal(x.shape), jnp.float32)
     scale = jnp.asarray(rng.standard_normal((1, 1, 2, 128)) + 1, jnp.float32)
