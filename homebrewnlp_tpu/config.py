@@ -25,6 +25,10 @@ from .core.dims import Dim
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
            "float16": jnp.float16, "float64": jnp.float32}
+# int8 is only valid for decode_cache_dtype (KV caches store per-row-
+# quantized int8 + f32 scales; model/decode.py) — the float keys above
+# would fail later and obscurely (e.g. integer param init)
+_CACHE_DTYPES = {**_DTYPES, "int8": jnp.int8}
 
 
 class BlockConfig:
@@ -237,7 +241,9 @@ class ModelParameter:
                      "decode_cache_dtype"):
             v = getattr(self, attr)
             if isinstance(v, str):
-                setattr(self, attr, _DTYPES[v])
+                table = _CACHE_DTYPES if attr == "decode_cache_dtype" \
+                    else _DTYPES
+                setattr(self, attr, table[v])
 
         self.learning_rate_config = {
             key: cfg if isinstance(cfg, LearningRateConfig) else LearningRateConfig(**cfg)

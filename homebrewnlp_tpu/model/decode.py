@@ -97,7 +97,33 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     axis = x.axis(dim)
     full_dims = [key_dim_for(state, d) if d == dim else d for d in x.dims]
     store_dtype = state.cache_dtype or x.dtype
-    buf = _cache(name, [d.size for d in full_dims], store_dtype)
+    shape = [d.size for d in full_dims]
+    if store_dtype == jnp.int8:
+        # per-row symmetric quantization (scale over the trailing feature
+        # axis): wide-batch decode is cache-READ-bandwidth-bound
+        # (BASELINE.md), so int8 halves the bytes vs bf16 at ~1/127
+        # relative error; scales ride a sibling f32 cache (1/F the size).
+        # The scale collapses the LAST axis, so the scattered sequence axis
+        # must not be last — otherwise every step would clamp into the one
+        # scale slot and silently dequantize old positions with new scales
+        assert axis != len(shape) - 1, (
+            "int8 decode caches need a trailing feature axis; the sequence "
+            f"axis is last for {name!r} — use a float decode_cache_dtype")
+        xf = x.data.astype(jnp.float32)
+        scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
+        q = jnp.round(xf / jnp.maximum(scale, 1e-12)
+                      ).clip(-127, 127).astype(jnp.int8)
+        buf = _cache(name, shape, jnp.int8)
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, q, state.pos, axis)
+        sname = name + "_scale"
+        sbuf = _cache(sname, shape[:-1] + [1], jnp.float32)
+        sbuf = jax.lax.dynamic_update_slice_in_dim(sbuf, scale, state.pos,
+                                                   axis)
+        state.out[name] = buf
+        state.out[sname] = sbuf
+        deq = (buf.astype(jnp.float32) * sbuf).astype(x.dtype)
+        return nt(deq, full_dims)
+    buf = _cache(name, shape, store_dtype)
     buf = jax.lax.dynamic_update_slice_in_dim(
         buf, x.data.astype(store_dtype), state.pos, axis)
     state.out[name] = buf

@@ -24,6 +24,8 @@ def main():
     ap.add_argument("--config", default="configs/32big_mixer.json")
     ap.add_argument("--batches", default="1,8,32")
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--cache_dtype", default=None,
+                    help="decode_cache_dtype override (bfloat16/int8)")
     args = ap.parse_args()
 
     import jax
@@ -38,6 +40,8 @@ def main():
         cfg = json.load(f)
     cfg.update({"use_checkpointing": False, "dataset_configs": [],
                 "model_path": "/tmp/bench_decode"})
+    if args.cache_dtype:
+        cfg["decode_cache_dtype"] = args.cache_dtype
 
     for batch in [int(b) for b in args.batches.split(",")]:
         cfg["train_batch_size"] = batch

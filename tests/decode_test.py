@@ -191,3 +191,64 @@ def decode_cache_dtype_override_test():
     out = np.asarray(out)
     np.testing.assert_array_equal(out[:, 1:4], token_x[:, 1:4])
     assert out.min() >= 0 and out.max() < params.vocab_size
+
+
+def decode_cache_int8_test():
+    """int8 KV caches: per-row symmetric quantization with a sibling f32
+    scale cache (wide-batch decode is cache-read-bandwidth-bound; int8
+    halves the bytes vs bf16).  Checks the quantized roundtrip error bound
+    and that greedy decode runs with in-vocab outputs."""
+    cfg = {"block_config": MIXER_BLOCKS,
+           "memory_reduction_strategy": "revnet",
+           "decode_cache_dtype": "int8"}
+    params = make_params(**cfg)
+    model = Model(params)
+    rng = np.random.default_rng(2)
+    seq = params.sequence_dim.size
+    tps = params.token_patch_dim.size
+    token_x = rng.integers(0, params.vocab_size,
+                           (params.train_batch_size, seq, tps)).astype(np.int32)
+    batch = {"token_x": jnp.asarray(token_x), "token_y": jnp.asarray(token_x)}
+    variables = {k: jnp.asarray(v) for k, v in model.init(batch).items()}
+    caches = init_decode_caches(model, variables, jnp.asarray(token_x))
+    kv = {k: v for k, v in caches.items()
+          if "/kv" in k and not k.endswith("_scale")}
+    scales = {k: v for k, v in caches.items() if k.endswith("_scale")}
+    assert kv and scales, list(caches)[:6]
+    assert all(v.dtype == jnp.int8 for v in kv.values())
+    assert all(v.dtype == jnp.float32 and v.shape[-1] == 1
+               for v in scales.values())
+
+    out = jax.jit(make_kv_sampler(model))(
+        variables, jnp.asarray(token_x), jnp.asarray(4, jnp.int32),
+        jnp.asarray(0.0, jnp.float32), jnp.asarray(seq, jnp.int32),
+        jax.random.PRNGKey(0), caches)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, 1:4], token_x[:, 1:4])
+    assert out.min() >= 0 and out.max() < params.vocab_size
+
+
+def int8_spread_roundtrip_error_test():
+    """The quantize->dequantize path in decode.spread keeps per-element
+    relative error within the symmetric-int8 bound (~1/127 of the row max)."""
+    from homebrewnlp_tpu.core.dims import Dim
+    from homebrewnlp_tpu.core import scope as scope_mod
+    from homebrewnlp_tpu.model.decode import DecodeState, spread
+    from homebrewnlp_tpu.core.tensor import nt as nt_
+    rng = np.random.default_rng(0)
+    b, h, f, s = 2, 3, 64, 8
+    x = jnp.asarray(rng.standard_normal((b, 1, h, f)) * 3, jnp.float32)
+    dims = [Dim("batch", b), Dim("sequence", 1), Dim("heads", h),
+            Dim("features_per_head", f)]
+    state = DecodeState(jnp.int32(2), s, "sequence", {},
+                        cache_dtype=jnp.int8)
+    ctx = scope_mod.Context("apply", params={})
+    ctx.decode = state
+    with scope_mod.context(ctx):
+        out = spread(nt_(x, dims), dims[1])
+    got = np.asarray(out.data)[:, 2]                 # the written position
+    want = np.asarray(x)[:, 0]
+    bound = np.abs(want).max(-1, keepdims=True) / 127.0 + 1e-6
+    assert np.all(np.abs(got - want) <= bound * 1.01)
+    # untouched positions stay zero
+    assert np.all(np.asarray(out.data)[:, 0] == 0)
