@@ -74,7 +74,11 @@ def ring_backward_memory_test():
     comp = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(sd, sd, sd).compile()
     temp = comp.memory_analysis().temp_size_in_bytes
     dense_residuals = 8 * b * h * sq * sq * 4  # what autodiff would stash
-    assert temp < dense_residuals / 4, (temp, dense_residuals)
+    # /3 (not /4): the zigzag layout's per-hop chunk selects/concats cost
+    # ~45MB of copies at this shape (149MB vs 104MB contiguous, measured) in
+    # exchange for halving the attention FLOPs; the property pinned here is
+    # that residuals stay O(seq/P . d), far under the O(seq^2/P) stash
+    assert temp < dense_residuals / 3, (temp, dense_residuals)
 
 
 def sp_long_context_train_test():
