@@ -142,6 +142,17 @@ def main() -> int:
     n_chips = max(1, len(jax.devices()))
     tokens_per_sec_chip = tokens / dt / n_chips
 
+    # val loss: the driver metric is tokens/sec/chip + VAL LOSS
+    # (BASELINE.json); held-out batches from the same synthetic stream,
+    # forward-only with dropout off (Trainer.eval_loss)
+    try:
+        val_losses = [float(trainer.eval_loss(state, make_batch())["loss"])
+                      for _ in range(4)]
+        val_loss = sum(val_losses) / len(val_losses)
+    except Exception as exc:
+        print(f"val loss computation failed: {exc}", file=sys.stderr)
+        val_loss = None
+
     # MFU: exact matmul FLOPs from the jaxpr, 3x-forward convention (no
     # rematerialization credit — revnet's recompute is not "useful" FLOPs)
     try:
@@ -181,9 +192,17 @@ def main() -> int:
     out = {"metric": "LM tokens/sec/chip @ 32big_mixer",
            "value": round(tokens_per_sec_chip, 2),
            "unit": "tokens/sec/chip",
-           "vs_baseline": round(vs_baseline, 4)}
+           "vs_baseline": round(vs_baseline, 4),
+           # what vs_baseline compares against: the first recorded run of
+           # THIS benchmark (round 1), not the MTF reference — the reference
+           # publishes no single-chip numbers and pod hardware for a direct
+           # loss/throughput comparison is unavailable (BASELINE.md)
+           "baseline_ref": "round1 self-baseline (BENCH_BASELINE.json); "
+                           "MTF comparison hardware-blocked"}
     if mfu_frac is not None:
         out["mfu"] = round(mfu_frac, 4)
+    if val_loss is not None:
+        out["val_loss"] = round(val_loss, 4)
     print(json.dumps(out))
     return 0
 

@@ -217,6 +217,24 @@ class ModelParameter:
         # lax.scan unroll factor for the depth scan (XLA overlap vs memory)
         self.scan_unroll = 1
         self.gradient_checkpointing_policy = "nothing_saveable"
+        # held-out validation loss (the driver metric is tokens/sec/chip
+        # + VAL LOSS @ 32big_mixer — the reference has no eval loop, this is
+        # a gap against the project's own success metric).  Every
+        # ``eval_interval`` train steps, run ``eval_steps`` forward-only
+        # batches (dropout off, no rng, same mesh/strategy) and log
+        # val/loss + val/accuracy.  Eval data: ``eval_dataset_configs``
+        # (same schema as dataset_configs) when given; otherwise, with
+        # ``eval_holdout_files`` = N > 0, the LAST N files (sorted order) of
+        # every text dataset glob are held out of training and evaluated on.
+        self.eval_interval = 0               # 0 = no eval
+        self.eval_steps = 4
+        self.eval_dataset_configs: typing.List[dict] = []
+        self.eval_holdout_files = 0
+        # web_api: up to this many queued completion requests batch into ONE
+        # decode call (decode is cache-read-bandwidth-bound — batch 8 is ~4x
+        # batch-1 aggregate throughput, BASELINE.md 'Decoding'); 1 = the
+        # reference's strictly-serial completions
+        self.serve_batch_size = 8
 
         self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():

@@ -54,6 +54,38 @@ def build_mesh(params: ModelParameter,
     return Mesh(dev_array, tuple(axes))
 
 
+def inference_mesh(params: ModelParameter,
+                   devices: typing.Optional[typing.Sequence[jax.Device]] = None
+                   ) -> Mesh:
+    """Serving mesh: the config's device layout with the 'pipe' and
+    'sequence' axes folded into 'data'.
+
+    Incremental decode has no pipeline schedule and no ring-attention
+    schedule (KV caches hold the full anonymized sequence), so those axes
+    would idle; folding them into 'data' keeps every device of the training
+    topology participating — parameters and KV caches shard over 'model'
+    (tensor parallelism), batches over 'data'.  The reference served
+    inference through the same SimdMeshImpl mesh as training
+    (/root/reference/src/run/run.py:200-308)."""
+    mesh = build_mesh(params, devices)
+    fold = mesh.shape.get("pipe", 1) * mesh.shape.get("sequence", 1)
+    if fold == 1:
+        return mesh
+    sizes = dict(mesh.shape)
+    data = sizes.get("data", 1) * fold
+    # build_mesh orders axes (data, pipe, model, sequence); a plain reshape
+    # would interleave 'model' between the folded axes, so transpose the
+    # device array to (data, pipe, sequence, model) first
+    order = [mesh.axis_names.index(a)
+             for a in ("data", "pipe", "sequence", "model")
+             if a in mesh.axis_names]
+    dev = np.transpose(mesh.devices, order)
+    model = sizes.get("model", 1)
+    if "model" in mesh.axis_names:
+        return Mesh(dev.reshape(data, model), ("data", "model"))
+    return Mesh(dev.reshape(data), ("data",))
+
+
 def spec_for_dims(params: ModelParameter, dims: typing.Sequence[Dim],
                   mesh: Mesh) -> PartitionSpec:
     """PartitionSpec from layout rules; each mesh axis used at most once."""
@@ -140,7 +172,8 @@ def place_tree(template_tree, host_tree):
 
 
 def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
-                mesh: Mesh) -> typing.Dict[str, jax.Array]:
+                mesh: Mesh, batch_axis: typing.Optional[int] = None
+                ) -> typing.Dict[str, jax.Array]:
     """Batch arrays shard along their leading (batch) axis over 'data'.
 
     Single-process: a plain ``device_put`` with the NamedSharding.  Multi-host
@@ -161,8 +194,11 @@ def shard_batch(params: ModelParameter, batch: typing.Dict[str, jax.Array],
     # identical full batches
     _, slice_count = process_data_slice(mesh) if nproc > 1 else (0, 1)
     # under macro-batching the leading axis is the macro index; the batch
-    # axis (the one sharded over 'data' and split across processes) is 1
-    batch_axis = 1 if params.macro_batching > 1 else 0
+    # axis (the one sharded over 'data' and split across processes) is 1.
+    # Callers feeding micro-shaped batches under a macro config (the eval
+    # pass) say so via ``batch_axis=0``
+    if batch_axis is None:
+        batch_axis = 1 if params.macro_batching > 1 else 0
     for key, value in batch.items():
         entries: typing.List[typing.Optional[str]] = [None] * value.ndim
         global_shape = list(value.shape)

@@ -340,17 +340,40 @@ class TextDataset:
 
     def __init__(self, params: ModelParameter, sub_batch_size: int,
                  slice_index: int = 0, slice_count: int = 1, runs_log=None,
-                 repeat: bool = True):
+                 repeat: bool = True, dataset_configs=None,
+                 holdout: typing.Optional[typing.Tuple[str, int]] = None):
+        """``dataset_configs`` overrides ``params.dataset_configs`` (the eval
+        pass feeds ``eval_dataset_configs`` through the same machinery).
+        ``holdout=("train"|"eval", n)``: with no explicit eval datasets, the
+        LAST n files (sorted order, deterministic) of every glob are held out
+        of the training side and form the eval side (config
+        ``eval_holdout_files``)."""
         self.params = params
         self.sub_batch_size = sub_batch_size
         streams = []
         weights = []
-        for cfg in params.dataset_configs:
+        configs = (params.dataset_configs if dataset_configs is None
+                   else dataset_configs)
+        for cfg in configs:
             if cfg.get('type', 'text') != 'text':
                 continue
             filenames = []
             for pattern in ([cfg['path']] if isinstance(cfg['path'], str) else cfg['path']):
                 filenames.extend(_expand_glob(pattern))
+            if holdout is not None and holdout[1] > 0:
+                side, n = holdout
+                filenames = sorted(set(filenames))
+                if n >= len(filenames):
+                    # raise on BOTH sides: the train side has nothing left,
+                    # and a standalone eval side would silently score the
+                    # entire training set as "held-out"
+                    raise ValueError(
+                        f"eval_holdout_files={n} holds out every file of "
+                        f"{cfg['path']!r} ({len(filenames)} files) — the "
+                        "split would leave no training data and the eval "
+                        "set would equal the full dataset")
+                filenames = filenames[-n:] if side == "eval" \
+                    else filenames[:-n]
             files, skips, phase, all_files = split_files(
                 filenames, slice_index, slice_count,
                 params.data_seed * int(params.shuffle_input_filenames), runs_log,

@@ -45,13 +45,43 @@ class Tokenizer:
 
 
 class InterfaceWrapper:
-    """complete(prompt, temperature, response_len) over a loaded model."""
+    """complete(prompt, temperature, response_len) over a loaded model.
 
-    def __init__(self, params: ModelParameter, model: Model, variables):
+    ``mesh``: optional serving mesh (core/sharding.py ``inference_mesh``) —
+    completions then run tensor/data-parallel over it, with the variables
+    expected to already carry their NamedShardings (run/modes.py
+    ``_load_model``)."""
+
+    def __init__(self, params: ModelParameter, model: Model, variables,
+                 mesh=None):
         self.params = params
         self.model = model
         self.variables = variables
+        self.mesh = mesh
         self.tokenizer = Tokenizer(params)
+        # decode-call counter: the REST batching test pins that N concurrent
+        # completions share device calls instead of running N serial decodes
+        self.decode_calls = 0
+        # batch-width -> (params, Model) views over the SAME variables: the
+        # batch dim is static in the named-dim substrate, so each distinct
+        # serving batch width needs its own abstract plan (eval_shape only —
+        # no device memory); widths are powers of two, so the cache is tiny
+        self._width_models: typing.Dict[int, tuple] = {
+            params.train_batch_size: (params, model)}
+
+    def _model_for_width(self, width: int):
+        if width not in self._width_models:
+            p = ModelParameter(self.params, train_batch_size=width)
+            p.train = False
+            m = Model(p)
+            # the block plan and parameter dims are batch-size independent
+            # (BlockSpec = (depth, cfg, names)); share them instead of
+            # re-running init, which would materialise — and discard — a
+            # full host-numpy copy of every parameter per new width
+            m.plan = self.model.plan
+            m.param_dims = dict(self.model.param_dims)
+            self._width_models[width] = (p, m)
+        return self._width_models[width]
 
     def complete_tokens(self, tokens: np.ndarray, temperature: float = 0.0,
                         response_len: typing.Optional[int] = None,
@@ -59,11 +89,61 @@ class InterfaceWrapper:
         seq = self.params.sequence_length // self.params.token_patch_size
         prompt_len = min(len(tokens), seq - 1)
         end = seq if response_len is None else min(seq, prompt_len + response_len)
+        self.decode_calls += 1
         out = sample_text(self.model, self.variables, tokens[None, :prompt_len],
                           initial_pos=prompt_len, temperature=temperature,
                           end_iterations=end, seed=seed,
-                          pad_random=True)  # reference interface.py:263
+                          pad_random=True,  # reference interface.py:263
+                          mesh=self.mesh)
         return out[0, :end, 0] if out.ndim == 3 else out[0, :end]
+
+    def complete_tokens_batch(self, token_lists, temperatures=None,
+                              response_lens=None, seed: int = 0
+                              ) -> typing.List[np.ndarray]:
+        """N prompts -> one decode call (decode is cache-read-bandwidth
+        bound: batch 8 is ~4x the aggregate throughput of batch 1,
+        BASELINE.md 'Decoding').  Per-row prompt lengths and temperatures
+        ride the samplers' batched ``initial_pos``/``temperature``; the
+        batch pads to the next power of two (bounded compile count) with
+        inert rows (initial_pos = seq - 1)."""
+        n = len(token_lists)
+        if n == 0:
+            return []
+        p = self.params
+        seq = p.sequence_length // p.token_patch_size
+        tps = p.token_patch_size
+        if temperatures is None:
+            temperatures = [0.0] * n
+        if response_lens is None:
+            response_lens = [None] * n
+        width = 1
+        while width < n:
+            width *= 2
+        rng = np.random.default_rng(seed)
+        token_x = rng.integers(0, p.vocab_size, (width, seq, tps)
+                               ).astype(np.int32)  # pad_random, ref :263
+        ip = np.full(width, seq - 1, np.int32)
+        temps = np.zeros(width, np.float32)
+        ends = []
+        for i, toks in enumerate(token_lists):
+            toks = np.asarray(toks).reshape(-1)[:seq - 1]
+            # broadcast across ALL patch lanes, matching the serial path
+            # (sampler.py prompt[:, :, None] -> token_x[:, :n]); lane-0-only
+            # writes would leave random pad in the upper lanes at tps > 1
+            token_x[i, :len(toks), :] = toks[:, None]
+            ip[i] = len(toks)
+            temps[i] = float(temperatures[i])
+            rl = response_lens[i]
+            ends.append(seq if rl is None else min(seq, len(toks) + int(rl)))
+        self.decode_calls += 1
+        _, model_w = self._model_for_width(width)
+        out = sample_text(model_w, self.variables, token_x,
+                          initial_pos=ip, temperature=temps,
+                          end_iterations=max(ends), seed=seed,
+                          mesh=self.mesh)
+        if out.ndim == 3:
+            out = out[:, :, 0]
+        return [out[i, :ends[i]] for i in range(n)]
 
     def complete(self, query: str, temperature: float = 0.0,
                  response_len: typing.Optional[int] = None, seed: int = 0) -> str:
@@ -107,7 +187,8 @@ def debug_sample_check(interface: InterfaceWrapper, seed: int = 0) -> float:
     token_x[0, :len(out), 0] = out[:seq]
     info = interface.model.apply(interface.variables,
                                  {"token_x": jnp.asarray(token_x),
-                                  "token_y": jnp.asarray(token_x)})
+                                  "token_y": jnp.asarray(token_x)},
+                                 mesh=interface.mesh)
     logits = np.asarray(info.token_out.data, np.float32)[0, :, 0]
     preds = logits.argmax(-1)
     start = min(len(prompt), seq - 1)

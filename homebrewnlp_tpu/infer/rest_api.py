@@ -26,6 +26,47 @@ from .interface import InterfaceWrapper
 DEFAULT_PORT = 62220
 
 
+def _complete_batch(interface: InterfaceWrapper,
+                    items: typing.List[typing.Tuple[str, dict]]
+                    ) -> typing.List[dict]:
+    """N queued /completion + /token_completion requests -> ONE decode call
+    (InterfaceWrapper.complete_tokens_batch).  Per-item parse errors answer
+    that item with an ``_error`` payload without failing the batch."""
+    import numpy as np
+    prompts, temps, rls, idx = [], [], [], []
+    results: typing.List[typing.Optional[dict]] = [None] * len(items)
+    for i, (path, body) in enumerate(items):
+        try:
+            if path == "/completion":
+                toks = interface.tokenizer.encode(body.get("prompt", ""))
+            else:
+                toks = np.asarray(body.get("tokens", []), np.int32).reshape(-1)
+            mt = body.get("max_tokens")
+            prompts.append(toks)
+            temps.append(float(body.get("temperature", 0.0)))
+            rls.append(int(mt) if mt else None)
+            idx.append(i)
+        except Exception as e:
+            results[i] = {"_error": str(e)}
+    if idx:
+        try:
+            outs = interface.complete_tokens_batch(prompts, temps, rls)
+            for j, i in enumerate(idx):
+                path, _ = items[i]
+                if path == "/completion":
+                    results[i] = {"completion": interface.tokenizer.decode(
+                        outs[j][len(prompts[j]):])}
+                else:
+                    results[i] = {"tokens": [int(t) for t in outs[j]]}
+        except Exception as e:
+            for i in idx:
+                results[i] = {"_error": str(e)}
+    return results
+
+
+BATCHED_PATHS = ("/completion", "/token_completion")
+
+
 def _handlers(interface: InterfaceWrapper):
     def completion(body: dict) -> dict:
         prompt = body.get("prompt", "")
@@ -125,7 +166,13 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
 
 
 def serve(params: ModelParameter, interface: InterfaceWrapper,
-          workers: int = 1, port: int = DEFAULT_PORT, isolate: bool = True):
+          workers: int = 1, port: int = DEFAULT_PORT, isolate: bool = True,
+          stop: typing.Optional[typing.Any] = None):
+    """Blocking device loop.  ``stop`` (a ``threading.Event``-alike) makes
+    shutdown clean: the loop notices it within its 1s poll, terminates the
+    HTTP subprocess, and shuts the Manager down — rather than the Manager
+    being GC'd out from under a live ``requests.get`` (which surfaced as an
+    EOFError traceback from the serve thread at interpreter teardown)."""
     handlers = _handlers(interface)
     if not isolate:
         print(f"serving on :{port} (in-process)")
@@ -154,22 +201,55 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     # older than the HTTP deadline are dropped (their client already got a
     # 500), and answers nobody collected are pruned so the Manager dict
     # cannot grow without bound under slow traffic.
-    while True:
-        try:
-            rid, t_enq, path, body = requests.get(timeout=1.0)
-        except queue_mod.Empty:
-            if not proc.is_alive():
-                raise RuntimeError(
-                    f"HTTP subprocess exited (code {proc.exitcode}); "
-                    "is the port already in use?")
-            continue
-        now = time.time()
-        for old_rid, entry in list(responses.items()):
-            if now - entry["t"] > DISPATCH_DEADLINE_S:
-                responses.pop(old_rid, None)
-        if now - t_enq > DISPATCH_DEADLINE_S:
-            continue  # client gave up; don't burn device time on it
-        try:
-            responses[rid] = {"t": now, "r": handlers[path](body)}
-        except Exception as e:
-            responses[rid] = {"t": now, "r": {"_error": str(e)}}
+    batch_limit = max(1, int(getattr(params, "serve_batch_size", 1) or 1))
+    try:
+        while stop is None or not stop.is_set():
+            group: typing.List[tuple] = []
+            try:
+                group.append(requests.get(timeout=1.0))
+                # drain whatever else queued while the last decode ran —
+                # concurrent completions then share ONE decode call
+                while len(group) < batch_limit:
+                    try:
+                        group.append(requests.get_nowait())
+                    except queue_mod.Empty:
+                        break
+            except queue_mod.Empty:
+                pass
+            except (EOFError, BrokenPipeError, ConnectionError, OSError):
+                # Manager torn down under us (interpreter exit with the loop
+                # in a daemon thread) — stop serving instead of tracebacking
+                break
+            if not group:
+                if not proc.is_alive():
+                    raise RuntimeError(
+                        f"HTTP subprocess exited (code {proc.exitcode}); "
+                        "is the port already in use?")
+                continue
+            now = time.time()
+            for old_rid, entry in list(responses.items()):
+                if now - entry["t"] > DISPATCH_DEADLINE_S:
+                    responses.pop(old_rid, None)
+            live = [g for g in group if now - g[1] <= DISPATCH_DEADLINE_S]
+            batchable = [g for g in live if g[2] in BATCHED_PATHS]
+            for rid, _, path, body in (g for g in live
+                                       if g[2] not in BATCHED_PATHS):
+                try:
+                    responses[rid] = {"t": now, "r": handlers[path](body)}
+                except Exception as e:
+                    responses[rid] = {"t": now, "r": {"_error": str(e)}}
+            if len(batchable) == 1:
+                rid, _, path, body = batchable[0]
+                try:
+                    responses[rid] = {"t": now, "r": handlers[path](body)}
+                except Exception as e:
+                    responses[rid] = {"t": now, "r": {"_error": str(e)}}
+            elif batchable:
+                outs = _complete_batch(interface,
+                                       [(g[2], g[3]) for g in batchable])
+                for (rid, *_), out in zip(batchable, outs):
+                    responses[rid] = {"t": now, "r": out}
+    finally:
+        proc.terminate()
+        proc.join(timeout=5.0)
+        manager.shutdown()

@@ -21,14 +21,25 @@ from ..config import ModelParameter
 from ..model import Model
 
 
-def make_sampler(model: Model) -> typing.Callable:
+def make_sampler(model: Model, mesh=None) -> typing.Callable:
     """Returns jit-able sample(variables, token_x, token_y, initial_pos,
-    temperature, end_iterations, key) -> tokens [batch, seq, patch]."""
+    temperature, end_iterations, key) -> tokens [batch, seq, patch].
+
+    ``mesh``: serving mesh (core/sharding.py ``inference_mesh``) — the
+    forward runs with the training layout rules (batch over 'data', heads
+    over 'model'), the reference's inference-through-the-training-mesh
+    design (/root/reference/src/run/run.py:200-308)."""
     params: ModelParameter = model.params
 
     def sample(variables, token_x, token_y, initial_pos, temperature,
                end_iterations, key):
         seq_axis = 1
+        batch = token_x.shape[0]
+        # per-row prompt lengths / temperatures (batched serving); scalars
+        # broadcast — the loop then starts at the smallest prompt end and a
+        # row guard keeps longer prompts untouched until their own start
+        ipb = jnp.broadcast_to(jnp.asarray(initial_pos, jnp.int32), (batch,))
+        tb = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (batch,))
 
         def cond_fn(state):
             position, *_ = state
@@ -37,22 +48,23 @@ def make_sampler(model: Model) -> typing.Callable:
         def body_fn(state):
             position, token_x, key = state
             info = model.apply(variables, {"token_x": token_x,
-                                           "token_y": token_y})
+                                           "token_y": token_y}, mesh=mesh)
             logits = info.token_out.data.astype(jnp.float32)  # [b, s, tp, v]
             key, sub = jax.random.split(key)
             u = jax.random.uniform(sub, logits.shape, jnp.float32,
                                    minval=1e-9, maxval=1.0)
-            logits = logits + jnp.log(-jnp.log(u)) * (-temperature)
+            logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
             tokens = jnp.argmax(logits, axis=-1)                 # [b, s, tp]
             # shift(+1): the prediction made at p-1 fills position p
             tokens = jnp.roll(tokens, 1, axis=seq_axis)
             tokens = tokens.at[:, 0].set(0)
             onehot = (jnp.arange(token_x.shape[seq_axis]) == position
                       ).astype(token_x.dtype)[None, :, None]
+            onehot = onehot * (position >= ipb[:, None, None]).astype(onehot.dtype)
             token_x = (tokens * onehot + token_x * (1 - onehot)).astype(token_x.dtype)
             return position + 1, token_x, key
 
-        position = jnp.asarray(initial_pos, jnp.int32)
+        position = jnp.min(ipb)
         _, token_x, _ = jax.lax.while_loop(cond_fn, body_fn,
                                            (position, token_x, key))
         return token_x
@@ -112,7 +124,7 @@ def init_decode_caches(model: Model, variables, token_x) -> dict:
             for k, v in decode_cache_shapes(model, variables, token_x).items()}
 
 
-def make_kv_sampler(model: Model) -> typing.Callable:
+def make_kv_sampler(model: Model, mesh=None) -> typing.Callable:
     """KV-cached sampler: O(1) compute per token via ``Model.apply_decode``.
 
     Replaces the reference's full-model-per-token while_loop
@@ -137,12 +149,18 @@ def make_kv_sampler(model: Model) -> typing.Callable:
             # which is what pushed flagship batch-32 decode out of memory
             caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
                       decode_cache_shapes(model, variables, token_x).items()}
+        batch = token_x.shape[0]
+        # per-row prompt lengths / temperatures (batched serving: each
+        # concurrent request keeps its own boundary and noise scale);
+        # scalars broadcast to the uniform single-request behaviour
+        ipb = jnp.broadcast_to(jnp.asarray(initial_pos, jnp.int32), (batch,))
+        tb = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (batch,))
         # iterations at position >= seq are no-ops in the full sampler (its
         # one-hot write misses); clamp instead of letting the update clamp
         end_iterations = jnp.minimum(end_iterations, token_x.shape[1])
         # full-sampler parity: its first iteration at position 0 writes 0
         # (the roll fills index 0 with zeros)
-        zero_first = (initial_pos == 0)
+        zero_first = (ipb == 0)[:, None]
         token_x = token_x.at[:, 0].set(
             jnp.where(zero_first, jnp.zeros_like(token_x[:, 0]), token_x[:, 0]))
 
@@ -153,15 +171,16 @@ def make_kv_sampler(model: Model) -> typing.Callable:
         def body_fn(state):
             q, token_x, caches, key = state
             cur = jax.lax.dynamic_slice_in_dim(token_x, q, 1, axis=1)
-            logits, caches = model.apply_decode(variables, cur, q, caches)
+            logits, caches = model.apply_decode(variables, cur, q, caches,
+                                                mesh=mesh)
             logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
             key, sub = jax.random.split(key)
             u = jax.random.uniform(sub, logits.shape, jnp.float32,
                                    minval=1e-9, maxval=1.0)
-            logits = logits + jnp.log(-jnp.log(u)) * (-temperature)
+            logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
             nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
             old = jax.lax.dynamic_slice_in_dim(token_x, q + 1, 1, axis=1)
-            new = jnp.where(q + 1 >= initial_pos, nxt, old)
+            new = jnp.where(q + 1 >= ipb[:, None, None], nxt, old)
             token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
                                                           axis=1)
             return q + 1, token_x, caches, key
@@ -174,16 +193,36 @@ def make_kv_sampler(model: Model) -> typing.Callable:
     return sample
 
 
+def _jit_sampler(model: Model, mesh, kind: str):
+    """Per-model cache of the jitted samplers: ``jax.jit`` keyed on function
+    identity would otherwise re-trace on EVERY ``sample_text`` call (each
+    call built a fresh closure) — for serving that was a re-trace per
+    request."""
+    cache = model.__dict__.setdefault("_sampler_jit_cache", {})
+    key = (mesh, kind)
+    if key not in cache:
+        fn = (make_kv_sampler(model, mesh=mesh) if kind == "kv"
+              else make_sampler(model, mesh=mesh))
+        cache[key] = jax.jit(fn)
+    return cache[key]
+
+
 def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
                 temperature=None, end_iterations=None, seed: int = 0,
-                use_cache: bool = True, pad_random: bool = False):
+                use_cache: bool = True, pad_random: bool = False, mesh=None):
     """Convenience host-level entry (pads/crops the prompt to sequence
     length); prompt_tokens: int array [batch, <=seq] or [batch, seq, patch].
 
     ``pad_random`` fills the region beyond the prompt with uniform random
     tokens instead of zeros (reference interface.py:263); with causal
     attention the generated stream is identical either way — it is parity
-    surface for the interactive modes."""
+    surface for the interactive modes.
+
+    ``mesh``: serving mesh — variables are expected to already carry their
+    NamedShardings (run/modes.py ``_load_model``); the prompt is placed
+    batch-over-'data' when divisible, and the decode KV caches inherit the
+    attention activation layout (heads over 'model') via the constraint in
+    model/decode.py ``spread``."""
     import numpy as np
     params = model.params
     seq = params.sequence_length // params.token_patch_size
@@ -205,10 +244,17 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
         temperature = params.sampling_temperature
     if end_iterations is None:
         end_iterations = seq
+    tokens_in = jnp.asarray(token_x)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        data = mesh.shape.get("data", 1)
+        spec = (PartitionSpec("data") if batch % data == 0 and data > 1
+                else PartitionSpec())
+        tokens_in = jax.device_put(tokens_in, NamedSharding(mesh, spec))
     if use_cache and not params.use_video:
         try:
-            fn = jax.jit(make_kv_sampler(model))
-            out = fn(variables, jnp.asarray(token_x),
+            fn = _jit_sampler(model, mesh, "kv")
+            out = fn(variables, tokens_in,
                      jnp.asarray(initial_pos, jnp.int32),
                      jnp.asarray(temperature, jnp.float32),
                      jnp.asarray(end_iterations, jnp.int32),
@@ -216,8 +262,8 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
             return np.asarray(out)
         except NotImplementedError:
             pass  # layer without a streaming form: full-forward fallback
-    fn = jax.jit(make_sampler(model))
-    out = fn(variables, jnp.asarray(token_x), jnp.asarray(token_x),
+    fn = _jit_sampler(model, mesh, "full")
+    out = fn(variables, tokens_in, tokens_in,
              jnp.asarray(initial_pos, jnp.int32),
              jnp.asarray(temperature, jnp.float32),
              jnp.asarray(end_iterations, jnp.int32),

@@ -431,7 +431,7 @@ class Model:
             "incremental decode supports text (gpt) mode only"
         state = DecodeState(jnp.asarray(pos, jnp.int32), p.sequence_dim.size,
                             p.sequence_dim.name, caches,
-                            cache_dtype=p.decode_cache_dtype)
+                            cache_dtype=p.decode_cache_dtype, model_params=p)
         ctx = scope.Context("apply", params=variables, mesh=mesh, decode=state)
         decode_dims = [Dim(d.name, 1) if d.name == p.sequence_dim.name else d
                        for d in p.token_dim_shape]

@@ -558,7 +558,8 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
                      for k, v in caches.items()}
         sub = decode_mod.DecodeState(state.pos, state.seq_len, state.seq_name,
                                      sl_caches,
-                                     cache_dtype=state.cache_dtype)
+                                     cache_dtype=state.cache_dtype,
+                                     model_params=state.model_params)
         saved_decode = ctx.decode
         ctx.decode = sub
         try:

@@ -40,7 +40,7 @@ class DecodeState:
 
     def __init__(self, pos: jax.Array, seq_len: int, seq_name: str,
                  caches: typing.Dict[str, jax.Array],
-                 cache_dtype: typing.Any = None):
+                 cache_dtype: typing.Any = None, model_params=None):
         self.pos = pos
         self.seq_len = seq_len
         self.seq_name = seq_name
@@ -50,6 +50,11 @@ class DecodeState:
         # KV cache dominates decode HBM at wide batch (BASELINE.md
         # 'Decoding'), so f32-calc configs can halve it with bfloat16 here.
         self.cache_dtype = cache_dtype
+        # ModelParameter, for layout rules: under a serving mesh the KV
+        # buffers are sharding-constrained like the activations they cache
+        # (heads -> 'model', batch -> 'data'), so tensor-parallel inference
+        # splits cache HBM 1/tp per device instead of replicating it
+        self.model_params = model_params
         self.out: typing.Dict[str, jax.Array] = dict(caches)
 
 
@@ -78,8 +83,28 @@ def _cache(name: str, shape: typing.Sequence[int], dtype) -> jax.Array:
     if name in state.caches:
         buf = state.caches[name]
         assert buf.shape == tuple(shape), (name, buf.shape, shape)
-        return buf.astype(dtype)
+        if buf.dtype != jnp.dtype(dtype):
+            # a value-cast here would silently corrupt history (e.g. f32
+            # buffers fed to a config now set to int8 would be clamped, not
+            # quantized) — a cache/config dtype mismatch must fail loudly
+            raise ValueError(
+                f"decode cache {name!r} holds {buf.dtype} but the config "
+                f"requests {jnp.dtype(dtype)}; caches cannot be reused "
+                "across decode_cache_dtype changes")
+        return buf
     return jnp.zeros(tuple(shape), dtype)
+
+
+def _constrain_cache(state: DecodeState, buf: jax.Array,
+                     dims: typing.Sequence[Dim]) -> jax.Array:
+    """Pin a KV buffer's sharding to the activation layout rules when a
+    serving mesh is active (no-op otherwise — single-device decode)."""
+    ctx = scope.current()
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None or state.model_params is None:
+        return buf
+    from ..core.sharding import with_constraint
+    return with_constraint(nt(buf, list(dims)), state.model_params, mesh).data
 
 
 def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
@@ -105,20 +130,27 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
         # relative error; scales ride a sibling f32 cache (1/F the size).
         # The scale collapses the LAST axis, so the scattered sequence axis
         # must not be last — otherwise every step would clamp into the one
-        # scale slot and silently dequantize old positions with new scales
-        assert axis != len(shape) - 1, (
-            "int8 decode caches need a trailing feature axis; the sequence "
-            f"axis is last for {name!r} — use a float decode_cache_dtype")
+        # scale slot and silently dequantize old positions with new scales.
+        # Config-reachable (decode_cache_dtype + layer layout), so this is a
+        # real error, not an assert that vanishes under ``python -O``
+        if axis == len(shape) - 1:
+            raise ValueError(
+                "int8 decode caches need a trailing feature axis; the "
+                f"sequence axis is last for {name!r} — use a float "
+                "decode_cache_dtype")
         xf = x.data.astype(jnp.float32)
         scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
         q = jnp.round(xf / jnp.maximum(scale, 1e-12)
                       ).clip(-127, 127).astype(jnp.int8)
         buf = _cache(name, shape, jnp.int8)
         buf = jax.lax.dynamic_update_slice_in_dim(buf, q, state.pos, axis)
+        buf = _constrain_cache(state, buf, full_dims)
         sname = name + "_scale"
         sbuf = _cache(sname, shape[:-1] + [1], jnp.float32)
         sbuf = jax.lax.dynamic_update_slice_in_dim(sbuf, scale, state.pos,
                                                    axis)
+        sbuf = _constrain_cache(state, sbuf,
+                                full_dims[:-1] + [Dim("_kv_scale", 1)])
         state.out[name] = buf
         state.out[sname] = sbuf
         deq = (buf.astype(jnp.float32) * sbuf).astype(x.dtype)
@@ -126,6 +158,7 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     buf = _cache(name, shape, store_dtype)
     buf = jax.lax.dynamic_update_slice_in_dim(
         buf, x.data.astype(store_dtype), state.pos, axis)
+    buf = _constrain_cache(state, buf, full_dims)
     state.out[name] = buf
     return nt(buf.astype(x.dtype), full_dims)
 

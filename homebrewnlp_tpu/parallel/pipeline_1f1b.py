@@ -165,8 +165,16 @@ def _choose_slots(kinds: np.ndarray, mbs: np.ndarray, chunks: np.ndarray,
                 fwd_tick[m, c, s] = t
             elif kinds[t, s] == BWD:
                 bwd_tick[m, c, s] = t
-    # liveness windows [arrival, backward] per (stage, chunk)
+    # forward-activation liveness windows [arrival, backward] per
+    # (stage, chunk) — the ``stash`` buffer
     windows: dict = {}
+    # backward-cotangent windows for the sibling ``bstash`` buffer, which
+    # reuses the same ``m mod P`` slot modulus: the cotangent for B(m,c,s)
+    # arrives one tick after the downstream backward fired (B(m,c,s+1), or
+    # ring-wrapped B(m,c+1,0) when s==S-1) and is consumed at the own B
+    # tick.  The last stage's last chunk seeds its cotangent locally from
+    # the loss head — no slot, no window.
+    bwindows: dict = {}
     for m in range(M):
         for c in range(V):
             for s in range(S):
@@ -180,19 +188,25 @@ def _choose_slots(kinds: np.ndarray, mbs: np.ndarray, chunks: np.ndarray,
                     arrive = fwd_tick[m, c, s]
                 windows.setdefault((s, c), []).append(
                     (m, arrive, bwd_tick[m, c, s]))
-    for p in range(S + 1, S * V + V + 3):
-        ok = True
-        for wins in windows.values():
+                if s < S - 1:
+                    b_arrive = bwd_tick[m, c, s + 1] + 1
+                elif c < V - 1:
+                    b_arrive = bwd_tick[m, c + 1, 0] + 1
+                else:
+                    continue  # loss-head seed, never stashed
+                bwindows.setdefault((s, c), []).append(
+                    (m, b_arrive, bwd_tick[m, c, s]))
+
+    def collision_free(win_map, p):
+        for wins in win_map.values():
             for i, (m1, a1, b1) in enumerate(wins):
                 for m2, a2, b2 in wins[i + 1:]:
                     if m1 % p == m2 % p and a1 <= b2 and a2 <= b1:
-                        ok = False
-                        break
-                if not ok:
-                    break
-            if not ok:
-                break
-        if ok:
+                        return False
+        return True
+
+    for p in range(S + 1, S * V + V + 3):
+        if collision_free(windows, p) and collision_free(bwindows, p):
             return p
     raise AssertionError("no collision-free stash size found")
 

@@ -45,10 +45,19 @@ def _dummy_batch(params: ModelParameter, batch_size: int = 1,
     return batch
 
 
-def _load_model(params: ModelParameter):
-    params = ModelParameter(params, train=False, train_batch_size=1)
+def _load_model(params: ModelParameter, batch_size: int = 1):
+    """Restore the model for a serving mode, placed on the serving mesh.
+
+    With more than one device the restored variables are laid out over the
+    config-derived ``inference_mesh`` (tensor parallelism over 'model',
+    batch over 'data'; 'pipe'/'sequence' folded into 'data' — decode has no
+    pipeline/ring schedule) so sample/query/web_api/debug run through the
+    same device topology as training, like the reference's non-train modes
+    through the SimdMeshImpl (/root/reference/src/run/run.py:200-308).
+    Returns (params, model, variables, mesh); mesh is None single-device."""
+    params = ModelParameter(params, train=False, train_batch_size=batch_size)
     model = Model(params)
-    batch = _dummy_batch(params)
+    batch = _dummy_batch(params, batch_size=batch_size)
     variables = model.init(batch)
     restored = ckpt.restore(params.model_path)
     if restored:
@@ -58,7 +67,14 @@ def _load_model(params: ModelParameter):
         print(f"loaded checkpoint at step {step}")
     else:
         print("no checkpoint found — sampling from random init")
-    return params, model, {k: jax.numpy.asarray(v) for k, v in variables.items()}
+    if len(jax.devices()) > 1:
+        mesh = shardlib.inference_mesh(params)
+        variables = shardlib.shard_params(params, variables,
+                                          model.param_dims, mesh)
+        print(f"serving mesh: {dict(mesh.shape)}")
+        return params, model, variables, mesh
+    return params, model, {k: jax.numpy.asarray(v)
+                           for k, v in variables.items()}, None
 
 
 def train_mode(params: ModelParameter, args):
@@ -67,11 +83,11 @@ def train_mode(params: ModelParameter, args):
 
 
 def sample_mode(params: ModelParameter, args):
-    params, model, variables = _load_model(params)
+    params, model, variables, mesh = _load_model(params)
     if params.use_video:
         _sample_video_mode(params, model, variables)
         return
-    interface = InterfaceWrapper(params, model, variables)
+    interface = InterfaceWrapper(params, model, variables, mesh=mesh)
     tok = Tokenizer(params)
     rng = np.random.default_rng(0)
     for i in range(params.num_of_sample):
@@ -104,13 +120,13 @@ def _sample_video_mode(params: ModelParameter, model, variables):
 
 
 def query_mode(params: ModelParameter, args):
-    params, model, variables = _load_model(params)
-    query_repl(InterfaceWrapper(params, model, variables))
+    params, model, variables, mesh = _load_model(params)
+    query_repl(InterfaceWrapper(params, model, variables, mesh=mesh))
 
 
 def web_api_mode(params: ModelParameter, args):
-    params, model, variables = _load_model(params)
-    interface = InterfaceWrapper(params, model, variables)
+    params, model, variables, mesh = _load_model(params)
+    interface = InterfaceWrapper(params, model, variables, mesh=mesh)
     from ..infer.rest_api import serve
     # reference: web_workers uvicorn processes (src/rest_api.py:84-87);
     # main.py has already folded CLI --workers into params.web_workers
@@ -118,8 +134,8 @@ def web_api_mode(params: ModelParameter, args):
 
 
 def debug_mode(params: ModelParameter, args):
-    params, model, variables = _load_model(params)
-    interface = InterfaceWrapper(params, model, variables)
+    params, model, variables, mesh = _load_model(params)
+    interface = InterfaceWrapper(params, model, variables, mesh=mesh)
     debug_similarity(interface)
     from ..infer.interface import debug_sample_check
     debug_sample_check(interface)

@@ -105,12 +105,41 @@ def make_dataset(params: ModelParameter, repeat: bool = True, mesh=None):
             # macro_batching sub-batches AND advances the step by the same
             dataset = itertools.islice(dataset, params.current_step, None)
     else:
+        # eval_holdout_files: the last N files of every glob are reserved
+        # for the eval pass and never trained on (data/inputs.py)
+        holdout = (("train", params.eval_holdout_files)
+                   if params.eval_holdout_files else None)
         dataset = TextDataset(params, params.train_batch_size // slice_count,
                               slice_index=slice_index,
                               slice_count=slice_count,
-                              runs_log=runs_log or None, repeat=repeat)
+                              runs_log=runs_log or None, repeat=repeat,
+                              holdout=holdout)
     return Prefetcher(_macro_batches(dataset, params.macro_batching),
                       depth=params.buffer_size)
+
+
+def make_eval_batches(params: ModelParameter, mesh=None
+                      ) -> typing.List[typing.Dict[str, np.ndarray]]:
+    """The FIXED held-out eval set: ``eval_steps`` micro batches, same every
+    eval so val loss is comparable across steps and runs.  Sources
+    ``eval_dataset_configs`` when given, else the ``eval_holdout_files``
+    tail of the training globs; same per-process slice geometry as
+    training."""
+    import itertools
+    slice_index, slice_count = data_slice_geometry(mesh)
+    cfgs = params.eval_dataset_configs or None
+    if cfgs is None and not params.eval_holdout_files:
+        raise ValueError("eval_interval > 0 needs eval_dataset_configs or "
+                         "eval_holdout_files > 0")
+    holdout = (("eval", params.eval_holdout_files) if cfgs is None else None)
+    ds = TextDataset(params, params.train_batch_size // slice_count,
+                     slice_index=slice_index, slice_count=slice_count,
+                     runs_log=None, repeat=True, dataset_configs=cfgs,
+                     holdout=holdout)
+    batches = list(itertools.islice(iter(ds), params.eval_steps))
+    if not batches:
+        raise ValueError("eval dataset produced no batches")
+    return batches
 
 
 def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
@@ -169,6 +198,14 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 f.write(trainer.lowered(state, first_batch).as_text())
             print(f"save_graph: lowered train step written to {path}")
 
+    eval_batches = None
+    if params.eval_interval:
+        if params.use_video:
+            print("WARNING: eval_interval is text-only; no val loss for "
+                  "video runs")
+        else:
+            eval_batches = make_eval_batches(params, mesh=mesh)
+
     logger = MetricLogger(params.model_path) if is_chief else None
     total_steps = train_steps if train_steps is not None else params.train_steps
     tokens_per_step = (params.train_batch_size * params.sequence_length
@@ -214,8 +251,19 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     metrics.update({f"moe/{path}/{s}": v
                                     for s, v in stats.items()
                                     if np.ndim(v) == 0})
-            if step_now % log_every < params.macro_batching:
-                last_metrics = {k: float(v) for k, v in metrics.items()}
+            ran_eval = (eval_batches is not None and
+                        step_now % params.eval_interval < params.macro_batching)
+            if ran_eval:
+                vals = [jax.device_get(trainer.eval_loss(state, eb))
+                        for eb in eval_batches]
+                metrics = dict(metrics, **{
+                    f"val/{k}": float(np.mean([v[k] for v in vals]))
+                    for k in vals[0]})
+            # an eval step always reaches the metric log, so every recorded
+            # val/loss point lands in metrics.jsonl/TB even off-cadence
+            if ran_eval or step_now % log_every < params.macro_batching:
+                last_metrics = {**last_metrics,
+                                **{k: float(v) for k, v in metrics.items()}}
                 if logger is not None:
                     logger.log(step_now, metrics,
                                tokens_per_step=params.train_batch_size * params.sequence_length)

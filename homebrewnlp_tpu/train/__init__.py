@@ -71,6 +71,7 @@ class Trainer:
         self.optimizer: typing.Optional[Optimizer] = None
         self._step_fn = None
         self._stats_fn = None
+        self._eval_fn = None
         self._rng_counter = 0
 
     # -- state -------------------------------------------------------------
@@ -101,19 +102,35 @@ class Trainer:
                           jnp.asarray(self.params.current_step, jnp.int32))
 
     # -- one micro step ----------------------------------------------------
+    def _1f1b_exclusion(self) -> typing.Optional[str]:
+        """Why a requested 1F1B schedule cannot run, or None if it can."""
+        p = self.params
+        if p.multi_loss_strategy in ("pcgrad", "mgda"):
+            return f"multi_loss_strategy={p.multi_loss_strategy!r}"
+        if not p.use_language or p.use_video:
+            return "non-text (video) model"
+        if p.contrastive_across_samples or p.contrastive_across_token_embeddings:
+            return "contrastive loss"
+        return None
+
     def _grads(self, variables: Params, batch, rng):
         p = self.params
 
         if (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1
-                and p.pipeline_schedule == "1f1b"
-                and p.multi_loss_strategy not in ("pcgrad", "mgda")
-                and p.use_language and not p.use_video
-                and not p.contrastive_across_samples
-                and not p.contrastive_across_token_embeddings):
-            # fused forward+backward schedule (loss head inside the last
-            # stage); computes grads itself rather than via jax.grad
-            return self.model.train_grads_1f1b(variables, batch, rng,
-                                               self.mesh)
+                and p.pipeline_schedule == "1f1b"):
+            reason = self._1f1b_exclusion()
+            if reason is None:
+                # fused forward+backward schedule (loss head inside the last
+                # stage); computes grads itself rather than via jax.grad
+                return self.model.train_grads_1f1b(variables, batch, rng,
+                                                   self.mesh)
+            # config asked for 1f1b but an excluded feature forces GPipe —
+            # say so loudly instead of silently changing the schedule
+            import warnings
+            warnings.warn(
+                f"pipeline_schedule='1f1b' requested but {reason} is not "
+                "supported by the fused schedule; falling back to GPipe "
+                "(parallel/pipeline.py)", stacklevel=2)
 
         def loss_of(v, idx=None):
             info = self.model.apply(v, batch, rng, mesh=self.mesh)
@@ -230,6 +247,32 @@ class Trainer:
         if self.mesh is not None:
             batch = shardlib.shard_batch(self.params, batch, self.mesh)
         return self._step_fn(state, batch, rng)
+
+    def eval_loss(self, state: TrainState,
+                  batch: typing.Dict[str, jax.Array]
+                  ) -> typing.Dict[str, jax.Array]:
+        """Forward-only held-out loss/accuracy on one eval batch.
+
+        Deterministic: traced with ``params.train`` False (dropout off, no
+        router-aux injection) and no rng, on the same mesh as training — the
+        driver metric is tokens/sec/chip + VAL LOSS (BASELINE.json), and this
+        is its loss half.  Compiled once; the eval batch must be shaped like
+        a train micro batch (no macro axis)."""
+        p = self.params
+        if self._eval_fn is None:
+            def eval_fn(variables, batch):
+                saved = p.train
+                p.train = False  # trace-time flag: dropout/aux-inject off
+                try:
+                    info = self.model.apply(variables, batch, rng=None,
+                                            mesh=self.mesh)
+                finally:
+                    p.train = saved
+                return _info_metrics(info)
+            self._eval_fn = jax.jit(eval_fn)
+        if self.mesh is not None:
+            batch = shardlib.shard_batch(p, batch, self.mesh, batch_axis=0)
+        return self._eval_fn(state.variables, batch)
 
     def moe_stats(self, state: TrainState, batch: typing.Dict[str, jax.Array],
                   rng: typing.Optional[jax.Array] = None

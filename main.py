@@ -18,9 +18,12 @@ def main():
                     help="path to the model config JSON")
     ap.add_argument("--tpu", type=str, default="",
                     help="accepted for compatibility; jax discovers devices")
-    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="REST worker count; overrides web_workers in the "
+                         "config only when given explicitly")
     ap.add_argument("--run_mode", type=str, default="train",
-                    choices=["train", "sample", "query", "web_api", "debug"])
+                    choices=["train", "sample", "query", "web_api", "debug",
+                             "debug_old"])
     ap.add_argument("--debug_grad", action="store_true")
     args = ap.parse_args()
 
@@ -39,8 +42,10 @@ def main():
 
     params = ModelParameter(config)
     params.debug_gradients = args.debug_grad
-    # CLI --workers overrides the config (reference src/main.py:60)
-    params.web_workers = args.workers
+    # CLI --workers overrides the config (reference src/main.py:60) — but
+    # only when actually passed, so web_workers in the JSON stays effective
+    if args.workers is not None:
+        params.web_workers = args.workers
     params.train = args.run_mode == "train"
     if not params.use_autoregressive_sampling and args.run_mode in ("sample",):
         print("use_autoregressive_sampling is off; enabling for sample mode")

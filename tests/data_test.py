@@ -344,3 +344,44 @@ def resume_sliced_test(tmp_path):
             for j, (w, got) in enumerate(zip(want, resumed)):
                 np.testing.assert_array_equal(
                     got, w, err_msg=f"k={k} slice={s} step={j}")
+
+
+def eval_holdout_split_test(tmp_path):
+    """eval_holdout_files reserves the sorted file tail: the train side never
+    reads those files, the eval side reads ONLY them, and holding out every
+    file is a loud error (run/train_loop.py make_eval_batches feeds the
+    'eval' side; make_dataset the 'train' side)."""
+    import numpy as np
+    from homebrewnlp_tpu.data.inputs import TextDataset
+    from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example
+
+    data_dir = tmp_path / "holdout"
+    os.makedirs(data_dir)
+    # distinct constant token per file makes provenance checkable
+    for i in range(4):
+        tokens = np.full(512, i + 1, np.uint8)
+        with RecordWriter(str(data_dir / f"f_{i}.tfrecord")) as w:
+            w.write(encode_example({"text": tokens.tobytes()}))
+    params = make_params(sequence_length=16, train_batch_size=2,
+                         interleaved_datasets=1,
+                         dataset_configs=[{"path": str(data_dir / "*"),
+                                           "type": "text", "weight": 1}])
+
+    def seen_tokens(holdout, n_batches=8):
+        ds = TextDataset(params, 2, holdout=holdout, repeat=True)
+        out = set()
+        it = iter(ds)
+        for _ in range(n_batches):
+            out.update(np.unique(next(it)["token_x"]).tolist())
+        return out
+
+    train_seen = seen_tokens(("train", 1))
+    eval_seen = seen_tokens(("eval", 1))
+    assert 4 not in train_seen, train_seen   # f_3 held out of training
+    assert eval_seen <= {0, 4}, eval_seen    # eval reads ONLY f_3
+    assert 4 in eval_seen
+    try:
+        TextDataset(params, 2, holdout=("train", 4))
+        raise AssertionError("expected ValueError for total holdout")
+    except ValueError:
+        pass

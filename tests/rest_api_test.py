@@ -48,9 +48,11 @@ def isolated_serving_test():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
+    stop = threading.Event()
     t = threading.Thread(target=rest_api.serve,
                          args=(interface.params, interface),
-                         kwargs={"port": port, "isolate": True}, daemon=True)
+                         kwargs={"port": port, "isolate": True, "stop": stop},
+                         daemon=True)
     t.start()
 
     def post(path, payload, timeout=60):
@@ -85,6 +87,11 @@ def isolated_serving_test():
         assert "error" in json.loads(e.read())
     # and the loop still answers afterwards
     assert post("/decode", {"tokens": [104, 105]})["prompt"] == "hi"
+    # clean shutdown: the loop notices the stop event within its poll and
+    # joins without a Manager-teardown traceback
+    stop.set()
+    t.join(timeout=15)
+    assert not t.is_alive()
 
 
 def http_server_test():
@@ -126,3 +133,75 @@ def http_server_test():
         assert out["tokens"] == [104, 105]
     finally:
         server.shutdown()
+
+
+def complete_batch_unit_test():
+    """_complete_batch: N mixed completion requests -> ONE decode call, with
+    per-item errors isolated, and greedy outputs identical to the serial
+    path."""
+    from homebrewnlp_tpu.infer import rest_api
+
+    interface = _interface()
+    serial = [interface.complete_tokens(np.asarray(t, np.int32), 0.0)
+              for t in ([1, 2, 3], [7, 8], [4, 5, 6, 7])]
+    interface.decode_calls = 0
+    items = [("/token_completion", {"tokens": [1, 2, 3], "temperature": 0.0}),
+             ("/token_completion", {"tokens": "bogus"}),
+             ("/token_completion", {"tokens": [7, 8], "temperature": 0.0}),
+             ("/token_completion", {"tokens": [4, 5, 6, 7],
+                                    "temperature": 0.0})]
+    outs = rest_api._complete_batch(interface, items)
+    assert interface.decode_calls == 1, interface.decode_calls
+    assert "_error" in outs[1]
+    for got, want in zip([outs[0], outs[2], outs[3]], serial):
+        assert got["tokens"] == [int(t) for t in want], (got, want)
+
+
+def batched_serving_concurrency_test():
+    """N concurrent clients share decode calls: while the first request
+    compiles/decodes, the rest queue and drain into one batched call —
+    strictly fewer device calls than serial (VERDICT r3 #6)."""
+    import socket
+    import concurrent.futures
+    from homebrewnlp_tpu.infer import rest_api
+
+    interface = _interface()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stop = threading.Event()
+    t = threading.Thread(target=rest_api.serve,
+                         args=(interface.params, interface),
+                         kwargs={"port": port, "isolate": True, "stop": stop},
+                         daemon=True)
+    t.start()
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/token_completion",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        import time
+        for _ in range(120):
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    return json.loads(resp.read())
+            except (ConnectionError, urllib.error.URLError):
+                time.sleep(0.25)
+        raise TimeoutError
+
+    try:
+        n = 8
+        with concurrent.futures.ThreadPoolExecutor(n) as pool:
+            futs = [pool.submit(post, {"tokens": [1, 2, i],
+                                       "temperature": 0.0})
+                    for i in range(n)]
+            outs = [f.result(timeout=300) for f in futs]
+        assert all(len(o["tokens"]) == 16 for o in outs), outs
+        assert interface.decode_calls < n, interface.decode_calls
+        # identical prompts must agree regardless of which batch they rode
+        assert outs[1]["tokens"] == post({"tokens": [1, 2, 1],
+                                          "temperature": 0.0})["tokens"]
+    finally:
+        stop.set()
+        t.join(timeout=15)

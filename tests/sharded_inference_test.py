@@ -1,0 +1,94 @@
+"""Sharded (multi-chip) inference: serving runs on the same device mesh as
+training, the reference's non-train-modes-through-the-SimdMeshImpl design
+(/root/reference/src/run/run.py:200-308).
+
+Greedy decode over a dp x tp mesh must produce IDENTICAL tokens to the
+single-device samplers: variables shard over 'model' (heads), the batch over
+'data', and the KV caches inherit the attention activation layout via the
+constraint in model/decode.py (so tensor parallelism splits cache HBM 1/tp).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backend import MIXER_BLOCKS, make_params
+from homebrewnlp_tpu.core import sharding as shardlib
+from homebrewnlp_tpu.infer.sampler import sample_text
+from homebrewnlp_tpu.model import Model
+
+
+def _model_and_vars(**overrides):
+    cfg = dict(heads=4, train_batch_size=4, sequence_length=16,
+               use_autoregressive_sampling=True,
+               mesh_shape_override={"data": 2, "model": 4})
+    cfg.update(overrides)
+    params = make_params(**cfg)
+    model = Model(params)
+    rng = np.random.default_rng(0)
+    seq = params.sequence_dim.size
+    tps = params.token_patch_dim.size
+    token_x = rng.integers(0, params.vocab_size,
+                           (params.train_batch_size, seq, tps)).astype(np.int32)
+    batch = {"token_x": token_x, "token_y": token_x.copy()}
+    variables = model.init(batch)
+    return params, model, variables, token_x
+
+
+def _parity(use_cache, **overrides):
+    params, model, variables, token_x = _model_and_vars(**overrides)
+    single = {k: jnp.asarray(v) for k, v in variables.items()}
+    ref = sample_text(model, single, token_x[:, :4, 0], initial_pos=4,
+                      temperature=0.0, use_cache=use_cache)
+
+    mesh = shardlib.build_mesh(params)
+    assert mesh.shape["model"] == 4 and mesh.shape["data"] == 2
+    sharded_vars = shardlib.shard_params(params, variables, model.param_dims,
+                                         mesh)
+    # weights carrying a heads dim actually shard over 'model'
+    heads_sharded = [k for k, v in sharded_vars.items()
+                     if any(s.spec for s in [v.sharding] if "model" in str(s.spec))]
+    assert heads_sharded, "no variable sharded over the model axis"
+    out = sample_text(model, sharded_vars, token_x[:, :4, 0], initial_pos=4,
+                      temperature=0.0, use_cache=use_cache, mesh=mesh)
+    np.testing.assert_array_equal(ref, out)
+
+
+def kv_sampler_sharded_parity_test():
+    _parity(use_cache=True)
+
+
+def full_sampler_sharded_parity_test():
+    _parity(use_cache=False)
+
+
+def kv_sampler_sharded_revnet_scan_parity_test():
+    """The stacked decode-cache scan path under the mesh (depth scan carries
+    sharded KV caches)."""
+    _parity(use_cache=True, memory_reduction_strategy="revnet", depth=2,
+            scan_layers=True)
+
+
+def kv_sampler_sharded_int8_cache_parity_test():
+    """int8 KV caches under the mesh: the quantized buffer and its sibling
+    f32 scale cache both ride the sharding constraint."""
+    _parity(use_cache=True, decode_cache_dtype="int8",
+            calculation_dtype="float32")
+
+
+def inference_mesh_folds_pipe_and_sequence_test():
+    """'pipe'/'sequence' axes fold into 'data' for serving (decode has no
+    pipeline or ring schedule): the training topology's devices all
+    participate, as dp x tp."""
+    params = make_params(heads=2, mesh_shape_override={
+        "data": 1, "pipe": 2, "model": 2, "sequence": 2})
+    mesh = shardlib.inference_mesh(params)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    assert mesh.devices.size == 8
+    assert len({d.id for d in mesh.devices.flat}) == 8
+
+
+def inference_mesh_passthrough_test():
+    """No pipe/sequence axes: the serving mesh is the training mesh."""
+    params = make_params(heads=4, mesh_shape_override={"data": 2, "model": 4})
+    mesh = shardlib.inference_mesh(params)
+    assert dict(mesh.shape) == {"data": 2, "model": 4}
