@@ -87,7 +87,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     def _finish():
         o_ref[...] = (acc_ref[...]
                       / jnp.maximum(l_ref[...], 1e-30)[:, None]).astype(o_ref.dtype)
-        lse_ref[...] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        # lse rides a [bh, s, 1] buffer: TPU lowering requires the last two
+        # block dims divisible by (8, 128) or equal to the array dims, which
+        # a [bh, s] row block of (1, block_q) cannot satisfy
+        lse_ref[...] = (m_ref[...]
+                        + jnp.log(jnp.maximum(l_ref[...], 1e-30)))[:, None]
 
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -114,9 +118,9 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
                   pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
                   pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0))],
         out_specs=[pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
-                   pl.BlockSpec((None, block_q), lambda i, j, kk: (i, j))],
+                   pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, s), jnp.float32)],
+                   jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
@@ -127,7 +131,7 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse[..., 0]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
@@ -161,10 +165,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[...][:, None])
+        p = jnp.exp(s - lse_ref[...])        # lse block is [bq, 1]
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - d_ref[...][:, None]) * scale
+        ds = p * (dp - d_ref[...]) * scale
         acc_ref[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -207,10 +211,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[...][:, None])
+        p = jnp.exp(s - lse_ref[...])        # lse block is [bq, 1]
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - d_ref[...][:, None]) * scale
+        ds = p * (dp - d_ref[...]) * scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -234,6 +238,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
+    # caller-chosen block sizes, exactly as in the forward — attention()
+    # passes the tuned 512 tiles for both passes; tests pass small blocks to
+    # exercise the multi-block causal-skip and diagonal-frontier paths
     bq = min(block_q, s)
     bk = min(block_k, s)
     nq, nk = s // bq, s // bk
@@ -242,10 +249,13 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     dot = dout.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     ot = out.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    # delta_i = dout_i . out_i (rowwise), the softmax-jacobian correction
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    # delta_i = dout_i . out_i (rowwise), the softmax-jacobian correction;
+    # lse/delta travel as [bh, s, 1] (TPU block-tiling rule, see forward)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), -1,
+                    keepdims=True)
+    lse3 = lse[..., None]
 
-    row_spec = pl.BlockSpec((None, bq), lambda i, j, kk: (i, j))
+    row_spec = pl.BlockSpec((None, bq, 1), lambda i, j, kk: (i, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk, num_k=nk,
                           scale=scale, causal=causal),
@@ -261,9 +271,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse3, delta)
 
-    qrow_spec = pl.BlockSpec((None, bq), lambda i, kk, j: (i, j))
+    qrow_spec = pl.BlockSpec((None, bq, 1), lambda i, kk, j: (i, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk, num_q=nq,
                           scale=scale, causal=causal),
@@ -282,7 +292,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse3, delta)
 
     def back(x):
         return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -365,7 +375,14 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 def attention(q, k, v, scale: typing.Optional[float] = None,
               causal: bool = True, interpret: typing.Optional[bool] = None):
-    """Dispatch: pallas kernel on TPU, fused XLA elsewhere."""
+    """Dispatch: pallas kernel on TPU, fused XLA elsewhere.
+
+    Block sizes (both passes): the largest power-of-two divisor of the
+    sequence up to 512 (always terminates at 128 given the s % 128 gate).
+    Measured on v5e at s=16384, d=128: forward 910 ms at 128x128 blocks vs
+    33.6 ms at 512x512 (27x), backward 219 ms vs 62 ms — small tiles are
+    grid-overhead/HBM-read bound; 1024-wide tiles gain only ~6-8% more and
+    double VMEM pressure."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -374,4 +391,7 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     s = q.shape[1]
     if not on_tpu or s % 128 != 0:
         return _xla_reference(q, k, v, scale, causal)
-    return flash_attention(q, k, v, scale, causal, 128, 128, False)
+    blk = 512
+    while s % blk:
+        blk //= 2
+    return flash_attention(q, k, v, scale, causal, blk, blk, False)
