@@ -54,6 +54,11 @@ class Context:
         self.mesh = mesh
         # model.decode.DecodeState during incremental (KV-cached) decoding
         self.decode = decode
+        # model.decode.PrefillState during single-pass prompt prefill: the
+        # FULL-length forward runs normally while the sequence-mixing ops
+        # additionally capture their decode caches (KV rows, cumsum totals,
+        # conv windows) so the sampler can skip the per-token prompt walk
+        self.prefill = None
         self.stack: typing.List[_Frame] = [_Frame("")]
         self.touched: typing.Optional[typing.List[str]] = [] if record_touched else None
         # name -> tuple[Dim] recorded at init; consumed by the optimizer's

@@ -124,7 +124,33 @@ def init_decode_caches(model: Model, variables, token_x) -> dict:
             for k, v in decode_cache_shapes(model, variables, token_x).items()}
 
 
-def make_kv_sampler(model: Model, mesh=None) -> typing.Callable:
+def _match_cache_layout(model: Model, produced: dict, expected: dict) -> dict:
+    """Re-layout prefill-produced caches (flat vs depth-stacked) to the
+    structure the decode body's discovery pass expects, then hard-check
+    shapes/dtypes — a silent mismatch would corrupt decode."""
+    from ..model import blocks as blocks_mod
+    params = model.params
+    if set(produced) != set(expected):
+        flat = blocks_mod.unstack_decode_caches(params, produced)
+        if set(flat) == set(expected):
+            produced = flat
+        else:
+            stacked = blocks_mod.stack_decode_caches(params, flat)
+            if set(stacked) != set(expected):
+                raise ValueError(
+                    "prefill produced a cache structure the decode body "
+                    f"does not expect: {sorted(set(produced) ^ set(expected))}")
+            produced = stacked
+    for k, v in expected.items():
+        if produced[k].shape != tuple(v.shape) or produced[k].dtype != v.dtype:
+            raise ValueError(f"prefill cache {k!r} is {produced[k].shape} "
+                             f"{produced[k].dtype}, decode expects "
+                             f"{tuple(v.shape)} {v.dtype}")
+    return produced
+
+
+def make_kv_sampler(model: Model, mesh=None, prefill: bool = False
+                    ) -> typing.Callable:
     """KV-cached sampler: O(1) compute per token via ``Model.apply_decode``.
 
     Replaces the reference's full-model-per-token while_loop
@@ -140,15 +166,22 @@ def make_kv_sampler(model: Model, mesh=None) -> typing.Callable:
     token_x[q] and writes q+1 (when q+1 >= initial_pos), walking q from 0 so
     caches fill causally through the prompt (prefill and decode share one
     loop).
+
+    ``prefill=True`` replaces the per-token prompt walk with ONE full
+    forward (``Model.apply_prefill``): the caches for steps
+    ``0..min(initial_pos)-2`` are captured from the full-length pass (flash
+    kernels and all) and the loop enters directly at the last prompt
+    position — O(1) model calls to first generated token instead of
+    O(prompt).  Greedy outputs are identical for float cache dtypes (the
+    decode-parity invariant: causal layers).  With lossy caches
+    (``decode_cache_dtype`` int8/bf16 below the calc dtype) prefill is
+    near- but not bit-identical — the walk computes each position from the
+    DEQUANTIZED history so its deeper activations carry compounded
+    quantization error, while prefill captures from the exact forward;
+    prefill's caches are the more faithful of the two.
     """
     def sample(variables, token_x, initial_pos, temperature, end_iterations,
                key, caches=None):
-        if not caches:
-            # build the zero caches INSIDE the trace: passing them as jit
-            # arguments keeps an unusable donated copy live — 2x cache HBM,
-            # which is what pushed flagship batch-32 decode out of memory
-            caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
-                      decode_cache_shapes(model, variables, token_x).items()}
         batch = token_x.shape[0]
         # per-row prompt lengths / temperatures (batched serving: each
         # concurrent request keeps its own boundary and noise scale);
@@ -163,6 +196,29 @@ def make_kv_sampler(model: Model, mesh=None) -> typing.Callable:
         zero_first = (ipb == 0)[:, None]
         token_x = token_x.at[:, 0].set(
             jnp.where(zero_first, jnp.zeros_like(token_x[:, 0]), token_x[:, 0]))
+
+        q_start = jnp.asarray(0, jnp.int32)
+        if not caches:
+            if prefill:
+                # one full forward captures the caches decode steps
+                # 0..n0-1 would write; the loop enters at q = n0 (the step
+                # that consumes the last prompt token and emits the first
+                # generated one).  Steps skipped this way write nothing:
+                # step q writes q+1 only when q+1 >= ipb, and
+                # q < n0 = min(ipb)-1 implies q+1 < min(ipb).
+                n0 = jnp.maximum(jnp.min(ipb) - 1, 0)
+                produced = model.apply_prefill(variables, token_x, n0,
+                                               mesh=mesh)
+                expected = decode_cache_shapes(model, variables, token_x)
+                caches = _match_cache_layout(model, produced, expected)
+                q_start = n0
+            else:
+                # build the zero caches INSIDE the trace: passing them as jit
+                # arguments keeps an unusable donated copy live — 2x cache
+                # HBM, which pushed flagship batch-32 decode out of memory
+                caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
+                          decode_cache_shapes(model, variables,
+                                              token_x).items()}
 
         def cond_fn(state):
             q, *_ = state
@@ -185,9 +241,8 @@ def make_kv_sampler(model: Model, mesh=None) -> typing.Callable:
                                                           axis=1)
             return q + 1, token_x, caches, key
 
-        q0 = jnp.asarray(0, jnp.int32)
         _, token_x, _, _ = jax.lax.while_loop(
-            cond_fn, body_fn, (q0, token_x, caches, key))
+            cond_fn, body_fn, (q_start, token_x, caches, key))
         return token_x
 
     return sample
@@ -201,8 +256,12 @@ def _jit_sampler(model: Model, mesh, kind: str):
     cache = model.__dict__.setdefault("_sampler_jit_cache", {})
     key = (mesh, kind)
     if key not in cache:
-        fn = (make_kv_sampler(model, mesh=mesh) if kind == "kv"
-              else make_sampler(model, mesh=mesh))
+        if kind == "kv":
+            fn = make_kv_sampler(model, mesh=mesh)
+        elif kind == "kv_prefill":
+            fn = make_kv_sampler(model, mesh=mesh, prefill=True)
+        else:
+            fn = make_sampler(model, mesh=mesh)
         cache[key] = jax.jit(fn)
     return cache[key]
 
@@ -253,7 +312,12 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
         tokens_in = jax.device_put(tokens_in, NamedSharding(mesh, spec))
     if use_cache and not params.use_video:
         try:
-            fn = _jit_sampler(model, mesh, "kv")
+            # prompts beyond position 1 prefill in one full forward instead
+            # of walking the prompt one decode step per token (O(1) model
+            # calls to first generated token); initial_pos <= 1 has nothing
+            # to prefill
+            kind = "kv_prefill" if int(np.min(initial_pos)) > 1 else "kv"
+            fn = _jit_sampler(model, mesh, kind)
             out = fn(variables, tokens_in,
                      jnp.asarray(initial_pos, jnp.int32),
                      jnp.asarray(temperature, jnp.float32),

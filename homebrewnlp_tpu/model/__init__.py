@@ -442,3 +442,60 @@ class Model:
             info, _ = build(p, None, None, None, tok, tgt, None, None, None,
                             plan=self.plan)
         return info.token_out.data, state.out
+
+    def apply_prefill(self, variables: typing.Dict[str, jax.Array],
+                      token_x: jax.Array, n: jax.Array,
+                      mesh: typing.Any = None) -> typing.Dict[str, jax.Array]:
+        """Capture the decode caches for prompt positions in ONE forward.
+
+        Returns the cache pytree equivalent to having run decode steps
+        ``0..n-1`` of ``apply_decode`` (model/decode.py ``PrefillState``
+        documents the per-cache argument), so the sampler can start its
+        while_loop at ``q = n`` instead of walking the prompt one model call
+        per token.  The full forward runs the normal (fastest) code paths —
+        flash kernels, depth scan — with the capture hooks riding along.
+        """
+        from .decode import PrefillState
+        assert self.plan is not None, "call init() first (or assign .plan)"
+        p = self.params
+        assert not p.use_video and p.use_language, \
+            "prefill supports text (gpt) mode only"
+        if mesh is not None and getattr(mesh, "shape", {}).get("sequence", 1) > 1:
+            raise ValueError("prefill needs the serving mesh (sequence axis "
+                             "folded into data); got a sequence-sharded mesh")
+        state = PrefillState(jnp.asarray(n, jnp.int32), p.sequence_dim.size,
+                             p.sequence_dim.name,
+                             cache_dtype=p.decode_cache_dtype, model_params=p)
+        ctx = scope.Context("apply", params=variables, mesh=mesh)
+        ctx.prefill = state
+
+        def _output_blocks(params, out):
+            # output_block_config layers may create caches too (e.g. a
+            # cumsum head block) — run them under the same "output" frame
+            # _build opens so their cache names match the decode build;
+            # contrastive configs skip them there as well
+            if (params.contrastive_across_token_embeddings
+                    or params.contrastive_across_samples):
+                return
+            token_out = out
+            for config_idx, config in enumerate(params.output_block_config):
+                token_out = block_part_fn(params, config, token_out,
+                                          f'lang_out{config_idx}')
+
+        def _prefill_forward(params, tok):
+            # same scope frames _build opens, minus the vocab projection and
+            # loss: the [b, s, patch, vocab] logits would be computed only
+            # to be discarded — at BPE vocab sizes a significant share of
+            # prefill FLOPs and HBM — and neither creates caches
+            spatial_ctx: Dim = tok.dims[-2]
+            src, _ = scope.scoped("input", _input, params, None, None, tok,
+                                  None, spatial_ctx, {})
+            out, _ = scope.scoped("body", _body, params, src, self.plan)
+            scope.scoped("output", _output_blocks, params, out)
+            params.attention_idx = 0
+
+        with scope.context(ctx):
+            tok = nt(token_x, p.token_dim_shape)
+            self.params.attention_idx = 0
+            scope.scoped(p.model_mode, _prefill_forward, p, tok)
+        return state.out

@@ -62,14 +62,17 @@ class ReplayBlock:
         outer_rng = None
         outer_mesh = None
         outer_decode = None
+        outer_prefill = None
         outer_sink = None
         if scope.in_context():
             outer_rng = scope.current().rng_key
             outer_mesh = scope.current().mesh
             outer_decode = scope.current().decode
+            outer_prefill = scope.current().prefill
             outer_sink = scope.current().stats_sink
         ctx = scope.Context("apply", params=subset, rng_key=None,
                             mesh=outer_mesh, decode=outer_decode)
+        ctx.prefill = outer_prefill
         ctx.stats_sink = outer_sink
         if outer_rng is not None:
             # `it` is the (possibly traced) depth index under scan-over-layers
@@ -598,6 +601,52 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
     return sum(streams[1:], streams[0])
 
 
+def _try_prefill_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
+                      strategy: str, attn_base: int
+                      ) -> typing.Optional[NamedTensor]:
+    """Scan the PREFILL body over depth (forward-only, full sequence).
+
+    Mirrors ``_try_decode_scan``'s structure: each iteration runs one
+    depth-unit in prefill mode, and the caches the iteration captures
+    (model/decode.py ``PrefillState``) return as scan ys — stacked on a
+    leading depth axis, which is exactly the ``__stacked__/<depth-0 name>``
+    layout the decode scan's sampler carry uses.  One full forward replaces
+    the O(prompt) per-token decode steps the sampler would otherwise spend
+    walking the prompt."""
+    from . import decode as decode_mod
+    state = ctx.prefill
+    pro = _scan_prologue(params, ctx, plan, src, attn_base)
+    if pro is None:
+        return None
+    stacked_params, shared, fns = pro
+    alpha = params.momentumnet_alpha
+
+    def step(carry, sl_params):
+        *streams, it = carry
+        sub = decode_mod.PrefillState(state.n, state.seq_len, state.seq_name,
+                                      cache_dtype=state.cache_dtype,
+                                      model_params=state.model_params)
+        saved = ctx.prefill
+        ctx.prefill = sub
+        try:
+            pairs = [(f, {**sl_params[c], **shared[c]})
+                     for c, f in enumerate(fns)]
+            streams = _forward_recurrence(strategy, alpha, pairs,
+                                          tuple(streams), it=it)
+        finally:
+            ctx.prefill = saved
+        return (*streams, it + 1), dict(sub.out)
+
+    carry0 = ((src, src, jnp.int32(0))
+              if strategy in ("revnet", "momentum")
+              else (src, jnp.int32(0)))
+    carry, ys = jax.lax.scan(step, carry0, stacked_params)
+    *streams, _ = carry
+    for rel, arr in ys.items():
+        state.out[STACKED_CACHE_PREFIX + rel] = arr
+    return sum(streams[1:], streams[0])
+
+
 # ---- body assembly -------------------------------------------------------
 
 def run_body_blocks(params: ModelParameter, src: NamedTensor,
@@ -641,31 +690,43 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         subsets.append({n: ctx.params[n] for n in names})
     params.attention_idx = attn_idx
 
+    def forward_only():
+        # the shared forward-only unrolled fallback (identical values to the
+        # trained forward — no custom_vjp/checkpoint wrappers)
+        carry = ((src, src) if strategy in ("revnet", "momentum")
+                 else (src,))
+        streams = _forward_recurrence(strategy, params.momentumnet_alpha,
+                                      zip(fns, subsets), carry)
+        return sum(streams[1:], streams[0])
+
     if ctx.decode is not None:
         # no gradients at decode time: run the invertible-forward recurrences
-        # plainly (identical values; custom_vjp/checkpoint wrappers would only
-        # complicate the while_loop trace)
+        # plainly (custom_vjp wrappers would only complicate the while_loop
+        # trace)
         if params.scan_layers and params.depth >= 2:
             scanned = _try_decode_scan(params, ctx, plan, src, strategy,
                                        attn_base)
             if scanned is not None:
                 return scanned, plan
-        carry = ((src, src) if strategy in ("revnet", "momentum")
-                 else (src,))
-        streams = _forward_recurrence(strategy, params.momentumnet_alpha,
-                                      zip(fns, subsets), carry)
-        return sum(streams[1:], streams[0]), plan
+        return forward_only(), plan
+
+    if getattr(ctx, "prefill", None) is not None:
+        # single-pass prompt prefill: forward-only like decode, captures
+        # riding ctx.prefill.out — the scan form stacks them per depth, the
+        # unrolled form writes the flat per-block names, matching the decode
+        # build's cache layouts
+        if params.scan_layers and params.depth >= 2:
+            scanned = _try_prefill_scan(params, ctx, plan, src, strategy,
+                                        attn_base)
+            if scanned is not None:
+                return scanned, plan
+        return forward_only(), plan
 
     if ctx.stats_sink is not None:
-        # forward-only stats probe: run the strategy-faithful recurrence as a
-        # plain python loop (identical values to the trained forward) so
-        # layer stats appended to the sink stay at the consumer's trace
-        # level — lax.scan / custom_vjp would strand them in a sub-trace
-        carry = ((src, src) if strategy in ("revnet", "momentum")
-                 else (src,))
-        streams = _forward_recurrence(strategy, params.momentumnet_alpha,
-                                      zip(fns, subsets), carry)
-        return sum(streams[1:], streams[0]), plan
+        # forward-only stats probe as a plain python loop so layer stats
+        # appended to the sink stay at the consumer's trace level —
+        # lax.scan / custom_vjp would strand them in a sub-trace
+        return forward_only(), plan
 
     mesh = ctx.mesh
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:

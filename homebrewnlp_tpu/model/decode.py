@@ -58,10 +58,57 @@ class DecodeState:
         self.out: typing.Dict[str, jax.Array] = dict(caches)
 
 
+class PrefillState:
+    """Single-pass prompt prefill: capture decode caches from a FULL forward.
+
+    The KV sampler's while_loop walks the prompt one decode step per token
+    (infer/sampler.py) — O(prompt) sequential model calls before the first
+    generated token.  A prefill runs the normal full-length forward ONCE
+    (flash kernels and all) with this state on the scope Context; the three
+    cache-writing op sites (attention KV via ``spread``'s full-mode twin,
+    cumsum via ``running_sum``'s, causal conv via ``rolling_window``'s)
+    additionally store into ``out`` the exact buffers decode steps
+    ``0..n-1`` would have produced, so the sampler can enter its loop at
+    ``q = n`` directly.
+
+    Correctness of each capture against the sequential decode semantics:
+      * KV buffers — decode step q writes row q *before* attending rows
+        0..q, so rows >= n (computed here from padding tokens) are always
+        overwritten before being read; rows < n hold exactly what decode
+        would have written (same values — causality — and the same int8
+        per-row quantization).
+      * cumsum — the decode cache after step q holds the total through q;
+        capture stores the full-forward cumsum row n-1 (zeros when n == 0).
+      * conv windows — rows [n-window, n) of the conv input, zero-padded
+        below 0, matching the rolling buffer before step n.
+    """
+
+    def __init__(self, n: jax.Array, seq_len: int, seq_name: str,
+                 cache_dtype: typing.Any = None, model_params=None):
+        self.n = n
+        self.seq_len = seq_len
+        self.seq_name = seq_name
+        self.cache_dtype = cache_dtype
+        self.model_params = model_params
+        self.out: typing.Dict[str, jax.Array] = {}
+
+
 def active() -> typing.Optional[DecodeState]:
     if not scope.in_context():
         return None
     return getattr(scope.current(), "decode", None)
+
+
+def prefill_active() -> typing.Optional[PrefillState]:
+    if not scope.in_context():
+        return None
+    return getattr(scope.current(), "prefill", None)
+
+
+def is_prefill_dim(state: typing.Optional[PrefillState], dim: Dim) -> bool:
+    """True when ``dim`` is the full-length sequence axis under prefill."""
+    return (state is not None and dim.name == state.seq_name
+            and dim.size == state.seq_len and state.seq_len != 1)
 
 
 def is_decode_dim(state: typing.Optional[DecodeState], dim: Dim) -> bool:
@@ -107,6 +154,31 @@ def _constrain_cache(state: DecodeState, buf: jax.Array,
     return with_constraint(nt(buf, list(dims)), state.model_params, mesh).data
 
 
+def _quantize_int8_rows(data: jax.Array):
+    """Per-row symmetric int8 quantization over the trailing feature axis:
+    returns (q int8, scale f32 with last axis 1).  The single definition is
+    shared by the decode-step scatter (``spread``) and the prefill capture
+    (``prefill_store_kv``) — their caches must be produced by bit-identical
+    formulas for the walk/prefill equivalence to hold."""
+    xf = data.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
+    q = jnp.round(xf / jnp.maximum(scale, 1e-12)
+                  ).clip(-127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _check_int8_layout(name: str, axis: int, ndim: int) -> None:
+    """The int8 scale collapses the LAST axis, so the scattered sequence
+    axis must not be last — otherwise every step would clamp into the one
+    scale slot and silently dequantize old positions with new scales.
+    Config-reachable (decode_cache_dtype + layer layout): a real error."""
+    if axis == ndim - 1:
+        raise ValueError(
+            "int8 decode caches need a trailing feature axis; the "
+            f"sequence axis is last for {name!r} — use a float "
+            "decode_cache_dtype")
+
+
 def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     """Scatter the current slice into a full-length cached buffer.
 
@@ -127,21 +199,9 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
         # per-row symmetric quantization (scale over the trailing feature
         # axis): wide-batch decode is cache-READ-bandwidth-bound
         # (BASELINE.md), so int8 halves the bytes vs bf16 at ~1/127
-        # relative error; scales ride a sibling f32 cache (1/F the size).
-        # The scale collapses the LAST axis, so the scattered sequence axis
-        # must not be last — otherwise every step would clamp into the one
-        # scale slot and silently dequantize old positions with new scales.
-        # Config-reachable (decode_cache_dtype + layer layout), so this is a
-        # real error, not an assert that vanishes under ``python -O``
-        if axis == len(shape) - 1:
-            raise ValueError(
-                "int8 decode caches need a trailing feature axis; the "
-                f"sequence axis is last for {name!r} — use a float "
-                "decode_cache_dtype")
-        xf = x.data.astype(jnp.float32)
-        scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
-        q = jnp.round(xf / jnp.maximum(scale, 1e-12)
-                      ).clip(-127, 127).astype(jnp.int8)
+        # relative error; scales ride a sibling f32 cache (1/F the size)
+        _check_int8_layout(name, axis, len(shape))
+        q, scale = _quantize_int8_rows(x.data)
         buf = _cache(name, shape, jnp.int8)
         buf = jax.lax.dynamic_update_slice_in_dim(buf, q, state.pos, axis)
         buf = _constrain_cache(state, buf, full_dims)
@@ -161,6 +221,68 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     buf = _constrain_cache(state, buf, full_dims)
     state.out[name] = buf
     return nt(buf.astype(x.dtype), full_dims)
+
+
+def prefill_store_kv(x: NamedTensor, dim: Dim) -> None:
+    """Prefill twin of :func:`spread`: store the FULL-length key/value tensor
+    into the cache ``spread`` would scatter into row-by-row.
+
+    Rows >= ``n`` hold values computed from padding tokens; decode step q
+    writes row q before attending, so they are never read.  Same name
+    (``ctx.full_name('kv')`` — the per-leaf counters make the prefill build
+    resolve the identical cache keys as the decode build), same storage
+    dtype, and the identical int8 per-row quantization + sibling scale
+    cache.
+    """
+    state = prefill_active()
+    assert state is not None and is_prefill_dim(state, dim)
+    ctx = scope.current()
+    name = "cache/" + ctx.full_name("kv")
+    axis = x.axis(dim)
+    full_dims = [anonymize_dim(d, state.seq_len) if d == dim else d
+                 for d in x.dims]
+    store_dtype = state.cache_dtype or x.dtype
+    shape = [d.size for d in full_dims]
+    if store_dtype == jnp.int8:
+        _check_int8_layout(name, axis, len(shape))
+        q, scale = _quantize_int8_rows(x.data)
+        state.out[name] = _constrain_cache(state, q, full_dims)
+        state.out[name + "_scale"] = _constrain_cache(
+            state, scale, full_dims[:-1] + [Dim("_kv_scale", 1)])
+        return
+    state.out[name] = _constrain_cache(state, x.data.astype(store_dtype),
+                                       full_dims)
+
+
+def prefill_store_cumsum(cs: NamedTensor, dim: Dim) -> None:
+    """Prefill twin of :func:`running_sum`: the decode cache after step q
+    holds the running total *through* q, so capture row ``n-1`` of the
+    full-forward cumsum (zeros when n == 0 — no steps have run)."""
+    state = prefill_active()
+    assert state is not None and is_prefill_dim(state, dim)
+    ctx = scope.current()
+    name = "cache/" + ctx.full_name("cumsum")
+    axis = cs.axis(dim)
+    idx = jnp.maximum(state.n - 1, 0)
+    row = jax.lax.dynamic_slice_in_dim(cs.data, idx, 1, axis)
+    state.out[name] = jnp.where(state.n > 0, row, jnp.zeros_like(row))
+
+
+def prefill_store_convwin(x: NamedTensor, dim: Dim, window: int) -> None:
+    """Prefill twin of :func:`rolling_window`: rows ``[n-window, n)`` of the
+    causal-conv input (zeros below position 0 — exactly the causal front
+    padding the rolling buffer starts from)."""
+    state = prefill_active()
+    assert state is not None and is_prefill_dim(state, dim)
+    ctx = scope.current()
+    name = "cache/" + ctx.full_name("convwin")
+    axis = x.axis(dim)
+    pad = [(0, 0)] * x.data.ndim
+    pad[axis] = (window, 0)
+    padded = jnp.pad(x.data, pad)
+    # padded index n corresponds to original row n - window
+    state.out[name] = jax.lax.dynamic_slice_in_dim(padded, state.n, window,
+                                                   axis)
 
 
 def running_sum(x: NamedTensor) -> NamedTensor:

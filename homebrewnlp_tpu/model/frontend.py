@@ -64,6 +64,11 @@ def convolution(args: BlockArgs) -> NamedTensor:
             dim, kernel)
         out = jnp.einsum("lkf,kfo->lo", xw.data, wdata)[:, None]
     else:
+        pstate = decode_mod.prefill_active()
+        if masked and decode_mod.is_prefill_dim(pstate, dim):
+            decode_mod.prefill_store_convwin(
+                nt(data, [Dim("_lead", lead), dim, Dim("_feat", features)]),
+                dim, kernel)
         if masked:
             data = jnp.pad(data, ((0, 0), (kernel - 1, 0), (0, 0)))
             padding = "VALID"

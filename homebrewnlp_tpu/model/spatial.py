@@ -30,10 +30,15 @@ def _key_dim(dim: Dim) -> Dim:
 
 
 def _anonymize_kv(x: NamedTensor, dim: Dim) -> NamedTensor:
-    """anonymize() at train time; KV-cache scatter at decode time."""
+    """anonymize() at train time; KV-cache scatter at decode time; at
+    prefill time additionally capture the full-length tensor into the cache
+    the decode steps would have filled (model/decode.py PrefillState)."""
     state = decode_mod.active()
     if decode_mod.is_decode_dim(state, dim):
         return decode_mod.spread(x, dim)
+    pstate = decode_mod.prefill_active()
+    if decode_mod.is_prefill_dim(pstate, dim):
+        decode_mod.prefill_store_kv(x, dim)
     return anonymize(x, dim)
 
 
@@ -60,6 +65,14 @@ def _plain_softmax_qkv(args: BlockArgs, dim: Dim, qry: NamedTensor,
         val = args.tensor
     else:
         val = activated_linear_out(base)
+    pstate = decode_mod.prefill_active()
+    if decode_mod.is_prefill_dim(pstate, dim):
+        # the kernel routes skip the dense path's _anonymize_kv sites, so
+        # capture here — same order (key, then val) and the same PRE-broadcast
+        # tensors, so the cache names, shapes, and values match the decode
+        # build exactly
+        decode_mod.prefill_store_kv(key, dim)
+        decode_mod.prefill_store_kv(val, dim)
     canonical = [d for d in args.tensor.dims
                  if d not in (dim, params.head_dim, params.key_dim)] \
         + [dim, params.head_dim, params.key_dim]
@@ -127,6 +140,19 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     if mesh is not None and (mesh.shape.get("sequence", 1) > 1
                              or mesh.shape.get("pipe", 1) > 1):
         return None
+    if mesh is not None:
+        # shard-divisibility gate BEFORE extracting qkv: _plain_softmax_qkv
+        # consumes scoped parameter counters (and, under prefill, the kv
+        # cache name counters), so bailing after it would leave the dense
+        # fallback resolving drifted names — params that init never created,
+        # and duplicate prefill captures
+        lead = 1
+        for d in args.tensor.dims:
+            if d not in (dim, args.params.head_dim, args.params.key_dim):
+                lead *= d.size
+        if (lead % max(1, mesh.shape.get("data", 1))
+                or args.params.head_dim.size % max(1, mesh.shape.get("model", 1))):
+            return None
     qkv = _plain_softmax_qkv(args, dim, qry, key, base)
     if qkv is None:
         return None
@@ -139,10 +165,6 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     else:
         import jax
         from jax.sharding import PartitionSpec as P
-        data = mesh.shape.get("data", 1)
-        model = mesh.shape.get("model", 1)
-        if shp[0] % max(1, data) or shp[2] % max(1, model):
-            return None
         spec = P("data" if "data" in mesh.axis_names else None, None,
                  "model" if "model" in mesh.axis_names else None, None)
         out = jax.shard_map(
@@ -166,7 +188,11 @@ def cumsum(args: BlockArgs) -> NamedTensor:
     state = decode_mod.active()
     if decode_mod.is_decode_dim(state, dim):
         return decode_mod.running_sum(args.tensor)
-    return tensor_cumsum(args.tensor, dim)
+    out = tensor_cumsum(args.tensor, dim)
+    pstate = decode_mod.prefill_active()
+    if decode_mod.is_prefill_dim(pstate, dim):
+        decode_mod.prefill_store_cumsum(out, dim)
+    return out
 
 
 def cummean(args: BlockArgs) -> NamedTensor:

@@ -26,6 +26,10 @@ def main():
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--cache_dtype", default=None,
                     help="decode_cache_dtype override (bfloat16/int8)")
+    ap.add_argument("--ttft", action="store_true",
+                    help="time-to-first-token: prompt fills positions "
+                         "0..seq-2 (seq-1 tokens), generate ONE token, "
+                         "prefill vs per-token walk")
     args = ap.parse_args()
 
     import jax
@@ -53,6 +57,33 @@ def main():
         variables = model.init({"token_x": x, "token_y": x})
         variables = {k: jnp.asarray(v) for k, v in variables.items()}
         token_x = jnp.zeros((batch, seq, tps), jnp.int32)
+        if args.ttft:
+            # prompt fills all but the last position; end after ONE generated
+            # token.  The walk pays one decode step per prompt token before
+            # it; prefill pays one full forward.
+            prompt = seq - 1
+            for kind, prefill in (("walk", False), ("prefill", True)):
+                try:
+                    fn = jax.jit(make_kv_sampler(model, prefill=prefill))
+                    a = (variables, token_x, jnp.int32(prompt),
+                         jnp.float32(0.0), jnp.int32(seq),
+                         jax.random.PRNGKey(0), None)
+                    t_compile = time.time()
+                    np.asarray(fn(*a))
+                    compile_s = time.time() - t_compile
+                    times = []
+                    for _ in range(args.repeats):
+                        t0 = time.time()
+                        np.asarray(fn(*a))
+                        times.append(time.time() - t0)
+                    print(json.dumps({
+                        "batch": batch, "seq": seq, "mode": kind,
+                        "prompt": prompt, "compile_s": round(compile_s, 1),
+                        "ttft_s": round(min(times), 4)}), flush=True)
+                except Exception as e:
+                    print(json.dumps({"batch": batch, "mode": kind,
+                                      "error": repr(e)[:300]}), flush=True)
+            continue
         try:
             # caches=None: zeros built inside the trace — no host-side cache
             # allocation, no unusable-donation double buffer

@@ -1,0 +1,251 @@
+"""Single-pass prompt prefill parity vs the per-token decode walk.
+
+``make_kv_sampler(prefill=True)`` replaces the sampler's O(prompt)
+per-token prompt walk with ONE full forward that captures the decode caches
+(model/decode.py ``PrefillState``), entering the while_loop at the last
+prompt position.  Greedy outputs must be IDENTICAL to the plain KV sampler
+(and hence the full-forward sampler) for every layer family with a
+streaming form — attention (dense and kernel-routed), cumsum/cummean,
+causal convolution — under every memory-reduction strategy, with float and
+int8 cache dtypes, scanned and unrolled depth stacks, and per-row prompt
+lengths (batched serving).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backend import MIXER_BLOCKS, make_params
+from homebrewnlp_tpu.infer.sampler import decode_cache_shapes, make_kv_sampler
+from homebrewnlp_tpu.model import Model
+
+
+def _setup(cfg_overrides, seed=0):
+    params = make_params(**cfg_overrides)
+    model = Model(params)
+    rng = np.random.default_rng(seed)
+    seq = params.sequence_dim.size
+    tps = params.token_patch_dim.size
+    token_x = rng.integers(0, params.vocab_size,
+                           (params.train_batch_size, seq, tps)).astype(np.int32)
+    batch = {"token_x": jnp.asarray(token_x), "token_y": jnp.asarray(token_x)}
+    variables = {k: jnp.asarray(v) for k, v in model.init(batch).items()}
+    return params, model, variables, token_x
+
+
+def _pair(cfg_overrides, initial_pos=5, end_iterations=None, seed=0,
+          temperature=0.0):
+    params, model, variables, token_x = _setup(cfg_overrides, seed)
+    seq = params.sequence_dim.size
+    end = seq if end_iterations is None else end_iterations
+    args = (variables, jnp.asarray(token_x),
+            jnp.asarray(initial_pos, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(end, jnp.int32), jax.random.PRNGKey(seed), None)
+    walk = np.asarray(jax.jit(make_kv_sampler(model))(*args))
+    pre = np.asarray(jax.jit(make_kv_sampler(model, prefill=True))(*args))
+    return walk, pre, token_x
+
+
+def _assert_parity(cfg, **kw):
+    walk, pre, token_x = _pair(cfg, **kw)
+    np.testing.assert_array_equal(walk, pre)
+
+
+def mixer_revnet_prefill_parity_test():
+    _assert_parity({"block_config": MIXER_BLOCKS,
+                    "memory_reduction_strategy": "revnet"})
+
+
+def mixer_momentum_prefill_parity_test():
+    _assert_parity({"block_config": MIXER_BLOCKS,
+                    "memory_reduction_strategy": "momentum"})
+
+
+def dot_product_prefill_parity_test():
+    """Flash/kernel-routed attention captures in _plain_softmax_qkv; the
+    CPU fallback runs the fused XLA reference — either way the capture
+    order (key, then val) must match the decode build's cache names."""
+    blocks = [{"layer": ["norm-shift-scale-features-group",
+                         "attention-dot_product-embedded-absolute"]}]
+    _assert_parity({"block_config": blocks,
+                    "memory_reduction_strategy": "none"})
+
+
+def biased_softmax_prefill_parity_test():
+    blocks = [{"layer": ["norm-shift-scale-features-group",
+                         "attention-dot_product-context-biased_softmax-absolute"]}]
+    _assert_parity({"block_config": blocks,
+                    "memory_reduction_strategy": "checkpoint"})
+
+
+def shared_key_value_prefill_parity_test():
+    """shared_key_value writes ONE kv cache (val = key skips the second
+    spread site); prefill must mirror that count or every later cache name
+    shifts."""
+    blocks = [{"layer": ["norm-shift-scale-features-group",
+                         "attention-dot_product-embedded-absolute-shared_key_value"]}]
+    _assert_parity({"block_config": blocks,
+                    "memory_reduction_strategy": "none"})
+
+
+def cumsum_prefill_parity_test():
+    """The cumsum capture stores the full-forward prefix row n-1 where
+    decode accumulates sequentially — associativity differs, so this also
+    guards that the difference stays below argmax-flipping size."""
+    blocks = [{"layer": ["norm-shift-scale-features-group", "cumsum"]},
+              {"layer": ["norm-shift-scale-features-group", "cummean",
+                         "feed_forward-in:relu"]}]
+    _assert_parity({"block_config": blocks,
+                    "memory_reduction_strategy": "momentum"})
+
+
+def convolution_prefill_parity_test():
+    blocks = [{"layer": ["norm-shift-scale-features-group", "convolution",
+                         "activation-gelu"]}]
+    _assert_parity({"block_config": blocks, "convolution_size": 4,
+                    "memory_reduction_strategy": "none"})
+
+
+def conv_window_longer_than_prompt_prefill_test():
+    """Prompt shorter than the conv kernel: the captured window's leading
+    rows are the causal zero padding."""
+    blocks = [{"layer": ["norm-shift-scale-features-group", "convolution",
+                         "activation-gelu"]}]
+    _assert_parity({"block_config": blocks, "convolution_size": 8,
+                    "memory_reduction_strategy": "none"}, initial_pos=3)
+
+
+def unrolled_stack_prefill_parity_test():
+    """scan_layers off: the unrolled prefill writes flat per-block cache
+    names (no __stacked__ grouping) — the layout matcher must pass them
+    through to the unrolled decode body unchanged."""
+    _assert_parity({"block_config": MIXER_BLOCKS,
+                    "memory_reduction_strategy": "revnet",
+                    "scan_layers": False})
+
+
+def int8_cache_prefill_test():
+    """int8 caches: EXACT parity with the walk is impossible by design —
+    the sequential walk computes each position's activations from the
+    DEQUANTIZED (lossy, ~1/127) history, so deeper-layer k/v inputs carry
+    compounded quantization error, while prefill captures from the exact
+    full forward.  Prefill's caches are the more accurate of the two.
+    Assert the quantized prompt rows agree within a few quantization steps
+    and the generated stream is structurally valid."""
+    from homebrewnlp_tpu.infer.sampler import _match_cache_layout
+    cfg = {"block_config": MIXER_BLOCKS,
+           "memory_reduction_strategy": "revnet",
+           "decode_cache_dtype": "int8"}
+    params, model, variables, token_x = _setup(cfg)
+    seq = params.sequence_dim.size
+    n0 = 4
+    expected = decode_cache_shapes(model, variables, jnp.asarray(token_x))
+    walk_caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in expected.items()}
+    for q in range(n0):
+        _, walk_caches = model.apply_decode(
+            variables, jnp.asarray(token_x[:, q:q + 1]), jnp.int32(q),
+            walk_caches)
+    pre = _match_cache_layout(
+        model, dict(model.apply_prefill(variables, jnp.asarray(token_x),
+                                        jnp.int32(n0))), expected)
+    checked = 0
+    for k, v in expected.items():
+        if v.dtype != jnp.int8:
+            continue
+        a, b = np.asarray(walk_caches[k]), np.asarray(pre[k])
+        # stacked layout [depth, batch, seq, ...]: sequence axis = 2
+        d = np.abs(a[:, :, :n0].astype(int) - b[:, :, :n0].astype(int))
+        assert d.max() <= 8, (k, d.max())
+        assert np.mean(d > 1) < 0.05, (k, np.mean(d > 1))
+        checked += 1
+    assert checked, f"no int8 caches discovered: {sorted(expected)[:4]}"
+    # generated stream: prompt preserved, tokens in vocab
+    out = np.asarray(jax.jit(make_kv_sampler(model, prefill=True))(
+        variables, jnp.asarray(token_x), jnp.asarray(5, jnp.int32),
+        jnp.asarray(0.0, jnp.float32), jnp.asarray(seq, jnp.int32),
+        jax.random.PRNGKey(0), None))
+    np.testing.assert_array_equal(out[:, 1:5], token_x[:, 1:5])
+    assert out.min() >= 0 and out.max() < params.vocab_size
+
+
+def per_row_prompt_prefill_parity_test():
+    """Batched serving: per-row prompt lengths; prefill covers only
+    min(ipb)-1 steps and the loop's row guard handles the longer prompts."""
+    params, model, variables, token_x = _setup(
+        {"block_config": MIXER_BLOCKS, "memory_reduction_strategy": "revnet"})
+    seq = params.sequence_dim.size
+    ipb = np.array([3, 7, 5, 9], np.int32)[:params.train_batch_size]
+    args = (variables, jnp.asarray(token_x), jnp.asarray(ipb),
+            jnp.asarray(0.0, jnp.float32), jnp.asarray(seq, jnp.int32),
+            jax.random.PRNGKey(0), None)
+    walk = np.asarray(jax.jit(make_kv_sampler(model))(*args))
+    pre = np.asarray(jax.jit(make_kv_sampler(model, prefill=True))(*args))
+    np.testing.assert_array_equal(walk, pre)
+    # per-row prompt regions preserved
+    for r, p in enumerate(ipb):
+        np.testing.assert_array_equal(pre[r, 1:p], token_x[r, 1:p])
+
+
+def initial_pos_zero_prefill_test():
+    """n0 clamps to 0: nothing to capture, prefill degenerates to the plain
+    walk (wasted forward, identical output)."""
+    _assert_parity({"block_config": MIXER_BLOCKS,
+                    "memory_reduction_strategy": "none"}, initial_pos=0)
+
+
+def prompt_fills_sequence_prefill_test():
+    """Prompt occupying all but the last position: the loop runs exactly
+    one step after prefill."""
+    _assert_parity({"block_config": MIXER_BLOCKS,
+                    "memory_reduction_strategy": "none"}, initial_pos=15)
+
+
+def prefill_cache_structure_matches_decode_test():
+    """apply_prefill must produce the cache pytree apply_decode's discovery
+    expects — keys, shapes, AND dtypes (the layout matcher re-stacks but
+    hard-fails on shape/dtype drift)."""
+    from homebrewnlp_tpu.infer.sampler import _match_cache_layout
+    for cfg in ({"block_config": MIXER_BLOCKS,
+                 "memory_reduction_strategy": "revnet"},
+                {"block_config": MIXER_BLOCKS,
+                 "memory_reduction_strategy": "revnet",
+                 "decode_cache_dtype": "int8"},
+                {"block_config": MIXER_BLOCKS,
+                 "memory_reduction_strategy": "revnet",
+                 "scan_layers": False}):
+        params, model, variables, token_x = _setup(cfg)
+        produced = jax.jit(
+            lambda v, t: model.apply_prefill(v, t, jnp.int32(5)))(
+                variables, jnp.asarray(token_x))
+        expected = decode_cache_shapes(model, variables, jnp.asarray(token_x))
+        matched = _match_cache_layout(model, dict(produced), expected)
+        assert set(matched) == set(expected)
+
+
+def output_block_cache_prefill_parity_test():
+    """output_block_config layers can create caches too (a cumsum head
+    block): apply_prefill runs the output blocks (but not the vocab
+    projection) so those caches are captured rather than crashing the
+    layout match."""
+    _assert_parity({"block_config": MIXER_BLOCKS,
+                    "output_block_config": [{"layer": ["cumsum"]}],
+                    "memory_reduction_strategy": "none"})
+
+
+def prefill_sample_text_route_test():
+    """sample_text picks the prefill sampler for real prompts and the plain
+    walk for initial_pos <= 1; both produce identical greedy streams."""
+    from homebrewnlp_tpu.infer.sampler import sample_text
+    params, model, variables, token_x = _setup(
+        {"block_config": MIXER_BLOCKS, "memory_reduction_strategy": "revnet"})
+    out_pre = sample_text(model, variables, token_x[:, :6, 0], initial_pos=6,
+                          temperature=0.0)
+    assert (model._sampler_jit_cache and
+            any(k[1] == "kv_prefill" for k in model._sampler_jit_cache))
+    walk = jax.jit(make_kv_sampler(model))(
+        variables, jnp.asarray(np.concatenate(
+            [token_x[:, :6], np.zeros_like(token_x[:, 6:])], 1)),
+        jnp.asarray(6, jnp.int32), jnp.asarray(0.0, jnp.float32),
+        jnp.asarray(params.sequence_dim.size, jnp.int32),
+        jax.random.PRNGKey(0), None)
+    np.testing.assert_array_equal(out_pre, np.asarray(walk))
