@@ -38,6 +38,60 @@ def _xla_reference(q, k, v, scale, causal):
     return out.astype(q.dtype)
 
 
+def _causal_split(qi, ki, block_q: int, block_k: int):
+    """(any overlap, fully live) block predicates for the causal mask.
+
+    Only blocks CROSSING the diagonal need the per-element mask; strictly
+    below it every pair is live.  The per-element iota/compare/select on a
+    [block_q, block_k] f32 tile is real VPU time at d=128 — the kernel is
+    VPU-bound on softmax elementwise work, not MXU-bound (measured: the
+    dk/dv kernel with twice the dots but no softmax bookkeeping runs ~2x
+    faster per cell than the forward), so masking only the ~1/num_blocks
+    diagonal cells is a direct win."""
+    live = ki * block_k <= qi * block_q + block_q - 1
+    full = ki * block_k + block_k - 1 <= qi * block_q
+    return live, full
+
+
+def _masked_step(qi, ki, block_q: int, block_k: int, causal: bool, score,
+                 accumulate):
+    """Shared causal dispatch for all three kernels: the mask-free interior
+    branch, the masked diagonal branch (mutually exclusive ``pl.when``s —
+    the FLOP counter relies on that, utils/flops.py), or the unconditional
+    non-causal form.  ``score()`` returns the scaled [bq, bk] logits;
+    ``accumulate(s)`` folds them into the kernel's state."""
+    from jax.experimental import pallas as pl
+
+    if not causal:
+        accumulate(score())
+        return
+    live, full = _causal_split(qi, ki, block_q, block_k)
+
+    @pl.when(full)
+    def _step_interior():
+        accumulate(score())
+
+    @pl.when(live & jnp.logical_not(full))
+    def _step_diagonal():
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        accumulate(jnp.where(q_pos >= k_pos, score(), _NEG_INF))
+
+
+def _make_score(q_ref, k_ref, scale):
+    """Scaled QK^T block logits on the RAW operand dtype with f32
+    accumulation: for bf16 inputs, bf16 x bf16 -> f32 on the MXU computes
+    exact products (the same numerics as an f32 matmul of the upcast
+    values) at the native MXU rate; the scale folds in AFTER, in f32."""
+    def score():
+        return jax.lax.dot_general(q_ref[...], k_ref[...],
+                                   (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * scale
+    return score
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, block_q: int, block_k: int, num_k: int, scale: float,
                   causal: bool):
@@ -56,32 +110,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: blocks strictly above the diagonal contribute nothing
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal \
-        else (ki < num_k)
-
-    @pl.when(live)
-    def _step():
-        q = q_ref[...].astype(jnp.float32) * scale      # [block_q, d]
-        k_blk = k_ref[...].astype(jnp.float32)          # [block_k, d]
-        v_blk = v_ref[...].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    def _accumulate(s):
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, s.max(-1))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_ref[...] = l_ref[...] * alpha + p.sum(-1)
+        # p rounds to the input dtype for the MXU (p in [0, 1]; flash-2
+        # standard — same precision class as a dense bf16 attention)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = m_new
+
+    _masked_step(qi, ki, block_q, block_k, causal,
+                 _make_score(q_ref, k_ref, scale), _accumulate)
 
     @pl.when(ki == num_k - 1)
     def _finish():
@@ -111,12 +154,23 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
                                num_k=num_k, scale=scale, causal=causal)
+    if causal:
+        # clamp dead cells' K/V fetches to the causal frontier: the block
+        # index then repeats the previous (live) iteration's, so the
+        # pipelining machinery skips the HBM fetch entirely (dead cells cost
+        # iteration overhead only, not bandwidth)
+        def _kmap(i, j, kk):
+            return (i, jnp.minimum(kk, (j * block_q + block_q - 1) // block_k),
+                    0)
+    else:
+        def _kmap(i, j, kk):
+            return (i, kk, 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q, num_k),
         in_specs=[pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
-                  pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
-                  pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0))],
+                  pl.BlockSpec((None, block_k, d), _kmap),
+                  pl.BlockSpec((None, block_k, d), _kmap)],
         out_specs=[pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
                    pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
@@ -148,30 +202,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal \
-        else (ki < num_k)
-
-    @pl.when(live)
-    def _step():
-        q = q_ref[...].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    def _accumulate(s):
+        # raw-dtype dots with f32 accumulation (see _make_score);
+        # p and ds round to the operand dtype before their MXU dots
         p = jnp.exp(s - lse_ref[...])        # lse block is [bq, 1]
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[...], v_ref[...],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - d_ref[...]) * scale
+        ds = (p * (dp - d_ref[...]) * scale).astype(k_ref.dtype)
         acc_ref[...] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds, k_ref[...], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _masked_step(qi, ki, block_q, block_k, causal,
+                 _make_score(q_ref, k_ref, scale), _accumulate)
 
     @pl.when(ki == num_k - 1)
     def _finish():
@@ -194,33 +238,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal \
-        else (qi < num_q)
-
-    @pl.when(live)
-    def _step():
-        q = q_ref[...].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    def _accumulate(s):
+        # raw-dtype dots with f32 accumulation (see _make_score)
         p = jnp.exp(s - lse_ref[...])        # lse block is [bq, 1]
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[...], v_ref[...],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - d_ref[...]) * scale
+        ds = (p * (dp - d_ref[...]) * scale).astype(q_ref.dtype)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[...], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[...], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _masked_step(qi, ki, block_q, block_k, causal,
+                 _make_score(q_ref, k_ref, scale), _accumulate)
 
     @pl.when(qi == num_q - 1)
     def _finish():
@@ -239,8 +272,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
 
     b, s, h, d = q.shape
     # caller-chosen block sizes, exactly as in the forward — attention()
-    # passes the tuned 512 tiles for both passes; tests pass small blocks to
-    # exercise the multi-block causal-skip and diagonal-frontier paths
+    # passes the tuned 1024 tiles for both passes; tests pass small blocks
+    # to exercise the multi-block causal-skip and diagonal-frontier paths
     bq = min(block_q, s)
     bk = min(block_k, s)
     nq, nk = s // bq, s // bk
@@ -255,14 +288,29 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
                     keepdims=True)
     lse3 = lse[..., None]
 
+    if causal:
+        # dead-cell fetch clamps (see the forward): repeat the frontier
+        # block's index so the pipeline skips the dead HBM fetch
+        def _kv_map(i, j, kk):
+            return (i, jnp.minimum(kk, (j * bq + bq - 1) // bk), 0)
+
+        def _q_map_dkv(i, kk, j):
+            return (i, jnp.maximum(j, (kk * bk) // bq), 0)
+    else:
+        def _kv_map(i, j, kk):
+            return (i, kk, 0)
+
+        def _q_map_dkv(i, kk, j):
+            return (i, j, 0)
+
     row_spec = pl.BlockSpec((None, bq, 1), lambda i, j, kk: (i, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk, num_k=nk,
                           scale=scale, causal=causal),
         grid=(b * h, nq, nk),
         in_specs=[pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0)),
-                  pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0)),
-                  pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0)),
+                  pl.BlockSpec((None, bk, d), _kv_map),
+                  pl.BlockSpec((None, bk, d), _kv_map),
                   pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0)),
                   row_spec, row_spec],
         out_specs=pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0)),
@@ -273,15 +321,15 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
 
-    qrow_spec = pl.BlockSpec((None, bq, 1), lambda i, kk, j: (i, j, 0))
+    qrow_spec = pl.BlockSpec((None, bq, 1), _q_map_dkv)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk, num_q=nq,
                           scale=scale, causal=causal),
         grid=(b * h, nk, nq),
-        in_specs=[pl.BlockSpec((None, bq, d), lambda i, kk, j: (i, j, 0)),
+        in_specs=[pl.BlockSpec((None, bq, d), _q_map_dkv),
                   pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
                   pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
-                  pl.BlockSpec((None, bq, d), lambda i, kk, j: (i, j, 0)),
+                  pl.BlockSpec((None, bq, d), _q_map_dkv),
                   qrow_spec, qrow_spec],
         out_specs=[pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
                    pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0))],
@@ -378,11 +426,12 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     """Dispatch: pallas kernel on TPU, fused XLA elsewhere.
 
     Block sizes (both passes): the largest power-of-two divisor of the
-    sequence up to 512 (always terminates at 128 given the s % 128 gate).
-    Measured on v5e at s=16384, d=128: forward 910 ms at 128x128 blocks vs
-    33.6 ms at 512x512 (27x), backward 219 ms vs 62 ms — small tiles are
-    grid-overhead/HBM-read bound; 1024-wide tiles gain only ~6-8% more and
-    double VMEM pressure."""
+    sequence up to 1024 (always terminates at 128 given the s % 128 gate).
+    Measured on v5e at s=16384, d=128 (in-jit loop): 128x128 tiles are
+    grid-overhead/HBM-read bound (round-4 fix, 27x); with the
+    diagonal-split kernels, 1024 tiles run the causal forward 38% faster
+    than 512 (14.8 vs 24.0 ms) — the forward is VPU-bound on softmax
+    bookkeeping, and bigger tiles amortise the per-cell state ops."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -391,7 +440,7 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     s = q.shape[1]
     if not on_tpu or s % 128 != 0:
         return _xla_reference(q, k, v, scale, causal)
-    blk = 512
+    blk = 1024
     while s % blk:
         blk //= 2
     return flash_attention(q, k, v, scale, causal, blk, blk, False)

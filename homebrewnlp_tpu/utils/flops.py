@@ -95,15 +95,39 @@ def count_matmul_flops(jaxpr) -> int:
                 total += max(count_matmul_flops(b.jaxpr) for b in branches)
         elif prim == "pallas_call":
             # kernel body runs once per grid cell (e.g. the flash-attention
-            # QK^T/PV block matmuls); grid product x body FLOPs
+            # QK^T/PV block matmuls); grid product x body FLOPs.  Every grid
+            # cell is counted as if live — the full-square convention for
+            # causal flash kernels, kept stable round-over-round
             inner = eqn.params.get("jaxpr")
             gm = eqn.params.get("grid_mapping")
             grid = getattr(gm, "grid", ()) if gm is not None else ()
             cells = int(np.prod([g for g in grid if isinstance(g, int)],
                                 dtype=np.int64)) if grid else 1
             if inner is not None:
-                total += cells * count_matmul_flops(getattr(inner, "jaxpr", inner))
+                total += cells * _pallas_body_flops(getattr(inner, "jaxpr",
+                                                            inner))
     return total
+
+
+def _pallas_body_flops(jaxpr) -> int:
+    """Per-cell FLOPs of a pallas kernel body.
+
+    ``pl.when`` branches lower to ``cond`` eqns; kernels that split the
+    causal mask into interior/diagonal variants (parallel/flash_attention.py
+    ``_causal_split``) emit MUTUALLY EXCLUSIVE conds containing the same
+    dots, so summing every cond (as the generic walker does) double-counts
+    — take the max over cond eqns instead, plus any unconditional dots."""
+    uncond = count_matmul_flops(
+        _StrippedJaxpr([e for e in jaxpr.eqns if e.primitive.name != "cond"]))
+    conds = [count_matmul_flops(b.jaxpr)
+             for e in jaxpr.eqns if e.primitive.name == "cond"
+             for b in e.params.get("branches", ())]
+    return uncond + (max(conds) if conds else 0)
+
+
+class _StrippedJaxpr:
+    def __init__(self, eqns):
+        self.eqns = eqns
 
 
 def forward_flops(fn, *args) -> int:
