@@ -80,6 +80,22 @@ def _masked_step(qi, ki, block_q: int, block_k: int, causal: bool, score,
         accumulate(jnp.where(q_pos >= k_pos, score(), _NEG_INF))
 
 
+def _frontier_kv_map(block_q: int, block_k: int, causal: bool):
+    """K/V BlockSpec index map with dead cells clamped to the causal
+    frontier (grid order (i, q, k) — k innermost): the repeated block index
+    makes the pipeline skip the dead HBM fetch, so dead cells cost
+    iteration overhead only.  The clamp bound is the last live k block of
+    ``_causal_split``'s liveness predicate; forward and dq share it."""
+    if causal:
+        def kv_map(i, j, kk):
+            return (i, jnp.minimum(kk, (j * block_q + block_q - 1) // block_k),
+                    0)
+    else:
+        def kv_map(i, j, kk):
+            return (i, kk, 0)
+    return kv_map
+
+
 def _make_score(q_ref, k_ref, scale):
     """Scaled QK^T block logits on the RAW operand dtype with f32
     accumulation: for bf16 inputs, bf16 x bf16 -> f32 on the MXU computes
@@ -154,17 +170,7 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
                                num_k=num_k, scale=scale, causal=causal)
-    if causal:
-        # clamp dead cells' K/V fetches to the causal frontier: the block
-        # index then repeats the previous (live) iteration's, so the
-        # pipelining machinery skips the HBM fetch entirely (dead cells cost
-        # iteration overhead only, not bandwidth)
-        def _kmap(i, j, kk):
-            return (i, jnp.minimum(kk, (j * block_q + block_q - 1) // block_k),
-                    0)
-    else:
-        def _kmap(i, j, kk):
-            return (i, kk, 0)
+    _kmap = _frontier_kv_map(block_q, block_k, causal)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q, num_k),
@@ -288,18 +294,13 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
                     keepdims=True)
     lse3 = lse[..., None]
 
+    _kv_map = _frontier_kv_map(bq, bk, causal)
     if causal:
-        # dead-cell fetch clamps (see the forward): repeat the frontier
-        # block's index so the pipeline skips the dead HBM fetch
-        def _kv_map(i, j, kk):
-            return (i, jnp.minimum(kk, (j * bq + bq - 1) // bk), 0)
-
+        # dkv's q-side twin of _frontier_kv_map (grid (i, k, q) — q
+        # innermost, dead cells BEFORE the first live q block (kk*bk)//bq)
         def _q_map_dkv(i, kk, j):
             return (i, jnp.maximum(j, (kk * bk) // bq), 0)
     else:
-        def _kv_map(i, j, kk):
-            return (i, kk, 0)
-
         def _q_map_dkv(i, kk, j):
             return (i, j, 0)
 

@@ -114,15 +114,20 @@ def _pallas_body_flops(jaxpr) -> int:
 
     ``pl.when`` branches lower to ``cond`` eqns; kernels that split the
     causal mask into interior/diagonal variants (parallel/flash_attention.py
-    ``_causal_split``) emit MUTUALLY EXCLUSIVE conds containing the same
-    dots, so summing every cond (as the generic walker does) double-counts
-    — take the max over cond eqns instead, plus any unconditional dots."""
+    ``_masked_step``) emit MUTUALLY EXCLUSIVE conds containing the SAME
+    dots, so summing every cond (as the generic walker does) double-counts.
+    Exclusivity is not visible in the jaxpr, but the exclusive mask pair
+    always has IDENTICAL per-branch dot counts (same shapes, masked vs
+    not) — so equal nonzero cond counts are deduplicated to one, while
+    conds with DIFFERING dot counts (two genuinely sequential gated
+    stages) are summed; a future two-stage kernel is over- rather than
+    silently under-counted."""
     uncond = count_matmul_flops(
         _StrippedJaxpr([e for e in jaxpr.eqns if e.primitive.name != "cond"]))
     conds = [count_matmul_flops(b.jaxpr)
              for e in jaxpr.eqns if e.primitive.name == "cond"
              for b in e.params.get("branches", ())]
-    return uncond + (max(conds) if conds else 0)
+    return uncond + sum(set(c for c in conds if c))
 
 
 class _StrippedJaxpr:

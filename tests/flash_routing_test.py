@@ -101,3 +101,34 @@ def flash_skips_biased_map_test():
     state_f, metrics_f = _step(True, flags)
     np.testing.assert_allclose(float(metrics_f["loss"]),
                                float(metrics_d["loss"]), rtol=1e-6)
+
+
+def flash_indivisible_gate_precedes_qkv_test():
+    """Shard-divisibility bail must happen BEFORE qkv extraction: bailing
+    after it has consumed scoped parameter counters (and prefill kv-cache
+    name counters), so the dense fallback would resolve names init never
+    created (KeyError) and double-capture prefill caches.  heads=2 on
+    model=4 forces the bail; the step must run and match the unmeshed
+    dense result."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from homebrewnlp_tpu.core import sharding as shardlib
+    params = _cfg(True, "dot_product-context", heads=2,
+                  features_per_head=32,
+                  mesh_shape_override={"data": 2, "model": 4}, tpu_size=8)
+    model = Model(params)
+    mesh = shardlib.build_mesh(params, jax.devices()[:8])
+    trainer = Trainer(params, model, mesh=mesh)
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    batch = {"token_x": jnp.asarray(x),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+    state = trainer.init_state(batch)
+    state, metrics = trainer.step(state, batch, rng=jax.random.PRNGKey(3))
+    _, metrics_u = _step(False, "dot_product-context", heads=2,
+                         features_per_head=32)
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(metrics_u["loss"]), rtol=1e-5)
