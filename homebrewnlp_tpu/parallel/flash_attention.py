@@ -153,34 +153,49 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                         + jnp.log(jnp.maximum(l_ref[...], 1e-30)))[:, None]
 
 
-def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
-    """Returns (out [b, s, h, d], lse [b*h, s]) — lse is the backward's
-    softmax residual (flash-2: p is recomputed per block as exp(s - lse))."""
+def kernel_block(s: int, cap: int = 1024) -> int:
+    """Tuned tile size: the largest power-of-two divisor of ``s`` up to the
+    cap (1024 — see ``attention``'s docstring for the measurements).  The
+    single source for both the single-chip dispatch and the ring-attention
+    hop path, so a retune cannot leave one of them on a stale size."""
+    blk = cap
+    while s % blk:
+        blk //= 2
+    return blk
+
+
+def _fwd_flat(qt, kt, vt, scale, causal, block_q, block_k, interpret,
+              out_dtype=None):
+    """Flat-core forward: q/k/v [bh, s, d] -> (out [bh, s, d], lse [bh, s]).
+
+    The flat layout is shared with the ring-attention hop path
+    (parallel/ring_attention.py) — each ring hop runs this kernel on one
+    chunk pair and merges the normalized (out, lse) partials outside;
+    ``out_dtype`` lets that caller take f32 partials so the cross-hop
+    accumulation rounds once at the end, not per hop."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s, h, d = q.shape
+    bh, s, d = qt.shape
+    sk = kt.shape[1]
     block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    num_k = s // block_k
-    # [b, s, h, d] -> [b*h, s, d]
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    block_k = min(block_k, sk)
+    num_k = sk // block_k
+    out_dtype = qt.dtype if out_dtype is None else out_dtype
 
     kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
                                num_k=num_k, scale=scale, causal=causal)
     _kmap = _frontier_kv_map(block_q, block_k, causal)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, s // block_q, num_k),
+        grid=(bh, s // block_q, num_k),
         in_specs=[pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
                   pl.BlockSpec((None, block_k, d), _kmap),
                   pl.BlockSpec((None, block_k, d), _kmap)],
         out_specs=[pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
                    pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), out_dtype),
+                   jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
@@ -191,7 +206,20 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse[..., 0]
+    return out, lse[..., 0]
+
+
+def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
+    """Returns (out [b, s, h, d], lse [b*h, s]) — lse is the backward's
+    softmax residual (flash-2: p is recomputed per block as exp(s - lse))."""
+    b, s, h, d = q.shape
+    # [b, s, h, d] -> [b*h, s, d]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out, lse = _fwd_flat(qt, kt, vt, scale, causal, block_q, block_k,
+                         interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
@@ -267,32 +295,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref,
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
-                      block_k, interpret):
-    """Flash-2 pallas backward: separate dq and dk/dv kernels, each skipping
-    causally-dead blocks — the dead half of the O(s²) work the XLA-scan
-    backward paid (it computed every q block against the FULL K row and
-    masked afterwards, VERDICT r3 weak #1)."""
+def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
+              interpret, out_dtype=None):
+    """Flat-core backward: operands [bh, s, d], lse/delta [bh, s, 1] ->
+    (dq, dk, dv) [bh, s, d].  ``lse``/``delta`` are the GLOBAL softmax
+    residuals — flash-2's decomposition makes per-block contributions
+    correct under any partitioning of the key space, which is what lets
+    the ring-attention backward run this same core per hop pair
+    (``out_dtype=f32`` there: per-hop grad pieces accumulate across P hops
+    and must not round per hop)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s, h, d = q.shape
-    # caller-chosen block sizes, exactly as in the forward — attention()
-    # passes the tuned 1024 tiles for both passes; tests pass small blocks
-    # to exercise the multi-block causal-skip and diagonal-frontier paths
-    bq = min(block_q, s)
-    bk = min(block_k, s)
-    nq, nk = s // bq, s // bk
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    dot = dout.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    ot = out.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    # delta_i = dout_i . out_i (rowwise), the softmax-jacobian correction;
-    # lse/delta travel as [bh, s, 1] (TPU block-tiling rule, see forward)
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), -1,
-                    keepdims=True)
-    lse3 = lse[..., None]
+    bh, s, d = qt.shape
+    sk = kt.shape[1]
+    nq, nk = s // bq, sk // bk
+    dq_dtype = qt.dtype if out_dtype is None else out_dtype
+    dk_dtype = kt.dtype if out_dtype is None else out_dtype
+    dv_dtype = vt.dtype if out_dtype is None else out_dtype
 
     _kv_map = _frontier_kv_map(bq, bk, causal)
     if causal:
@@ -308,14 +328,14 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk, num_k=nk,
                           scale=scale, causal=causal),
-        grid=(b * h, nq, nk),
+        grid=(bh, nq, nk),
         in_specs=[pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0)),
                   pl.BlockSpec((None, bk, d), _kv_map),
                   pl.BlockSpec((None, bk, d), _kv_map),
                   pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0)),
                   row_spec, row_spec],
         out_specs=pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), dq_dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -326,7 +346,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk, num_q=nq,
                           scale=scale, causal=causal),
-        grid=(b * h, nk, nq),
+        grid=(bh, nk, nq),
         in_specs=[pl.BlockSpec((None, bq, d), _q_map_dkv),
                   pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
                   pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
@@ -334,14 +354,40 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
                   qrow_spec, qrow_spec],
         out_specs=[pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
                    pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0))],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), dk_dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), dv_dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
+    return dq, dk, dv
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
+                      block_k, interpret):
+    """Flash-2 pallas backward: separate dq and dk/dv kernels, each skipping
+    causally-dead blocks — the dead half of the O(s²) work the XLA-scan
+    backward paid (it computed every q block against the FULL K row and
+    masked afterwards, VERDICT r3 weak #1)."""
+    b, s, h, d = q.shape
+    # caller-chosen block sizes, exactly as in the forward — attention()
+    # passes the tuned 1024 tiles for both passes; tests pass small blocks
+    # to exercise the multi-block causal-skip and diagonal-frontier paths
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    dot = dout.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    ot = out.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # delta_i = dout_i . out_i (rowwise), the softmax-jacobian correction;
+    # lse/delta travel as [bh, s, 1] (TPU block-tiling rule, see forward)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), -1,
+                    keepdims=True)
+    dq, dk, dv = _bwd_flat(qt, kt, vt, dot, lse[..., None], delta, scale,
+                           causal, bq, bk, interpret)
 
     def back(x):
         return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -441,7 +487,5 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     s = q.shape[1]
     if not on_tpu or s % 128 != 0:
         return _xla_reference(q, k, v, scale, causal)
-    blk = 1024
-    while s % blk:
-        blk //= 2
+    blk = kernel_block(s)
     return flash_attention(q, k, v, scale, causal, blk, blk, False)

@@ -270,23 +270,108 @@ def _from_zigzag(x, axis_name, n_shards):
     return jnp.concatenate([lo, hi], axis=1)
 
 
-def _zz_forward(axis_name, n_shards, scale, block_q, q, k, v):
+def _use_pallas_hops(use_pallas, cs: int) -> bool:
+    """Route zigzag hop pairs through the pallas flash kernels?
+
+    Default: on TPU (``HBNLP_RING_XLA=1`` forces the XLA chunk scans for
+    A/B).  The kernels need 128-divisible chunks; the XLA path remains for
+    everything else and for CPU (tests force ``use_pallas`` to exercise the
+    kernel path in interpret mode).  The forward and backward gate
+    independently — both produce/consume the same (out, lse) residual
+    contract, so mixing paths is numerically sound."""
+    import os
+    if cs % 128:
+        return False
+    if use_pallas is None:
+        return (jax.default_backend() not in ("cpu",)
+                and not os.environ.get("HBNLP_RING_XLA"))
+    return use_pallas
+
+
+def _pair_fwd_pallas(qp, k_blk, v_blk, m, l, acc, tri, scale, interpret):
+    """One zigzag chunk pair through the flash forward kernel + a
+    log-sum-exp state merge.
+
+    ``qp``/``k_blk``/``v_blk``: [b, h, cs, d] in the RAW input dtype
+    (unscaled — the kernel applies ``scale`` after its MXU dot); the
+    online-softmax state (m, l, acc) stays f32 outside.  The kernel returns
+    normalized (out_h, lse_h); merging into the running state is exact:
+    the pair's unnormalized contribution w.r.t. the new max m2 is
+    out_h·exp(lse_h - m2) with mass exp(lse_h - m2)."""
+    from .flash_attention import _fwd_flat, kernel_block
+    b, h, cs, d = qp.shape
+    blk = kernel_block(cs)
+    out_h, lse_h = _fwd_flat(qp.reshape(b * h, cs, d),
+                             k_blk.reshape(b * h, cs, d),
+                             v_blk.reshape(b * h, cs, d),
+                             scale, tri, blk, blk, interpret,
+                             out_dtype=jnp.float32)
+    out_h = out_h.reshape(b, h, cs, d)
+    lse_h = lse_h.reshape(b, h, cs)
+    m2 = jnp.maximum(m, lse_h)
+    em = jnp.exp(m - m2)
+    eh = jnp.exp(lse_h - m2)
+    acc2 = acc * em[..., None] + out_h * eh[..., None]
+    l2 = l * em + eh
+    return m2, l2, acc2
+
+
+def _pair_bwd_pallas(qp, do_p, delta_p, lse_p, k_blk, v_blk, tri, scale,
+                     interpret):
+    """One zigzag chunk pair through the flash backward kernels.
+
+    ``lse_p``/``delta_p`` are the GLOBAL residuals (flash-2: per-block
+    contributions are correct under any key partitioning), so each hop's
+    (dq, dk, dv) pieces simply accumulate."""
+    from .flash_attention import _bwd_flat, kernel_block
+    b, h, cs, d = qp.shape
+    blk = kernel_block(cs)
+    dq, dk, dv = _bwd_flat(qp.reshape(b * h, cs, d),
+                           k_blk.reshape(b * h, cs, d),
+                           v_blk.reshape(b * h, cs, d),
+                           do_p.reshape(b * h, cs, d),
+                           lse_p.reshape(b * h, cs, 1),
+                           delta_p.reshape(b * h, cs, 1),
+                           scale, tri, blk, blk, interpret,
+                           out_dtype=jnp.float32)
+    return (dq.reshape(b, h, cs, d), dk.reshape(b, h, cs, d),
+            dv.reshape(b, h, cs, d))
+
+
+def _zz_forward(axis_name, n_shards, scale, block_q, use_pallas, q, k, v):
     """Zigzag per-shard forward; q/k/v local [b, sq, h, d] in zigzag row
     order ([early chunk; late chunk]).  Returns (out, lse) in the same row
     order.  Every hop costs two fully-live cs x cs chunk pairs per device
     (see module docstring) — half the contiguous layout's FLOPs, perfectly
-    balanced."""
+    balanced.  On TPU each pair runs the pallas flash kernel
+    (``_pair_fwd_pallas``) — the single-chip A/B showed the XLA chunk
+    scans far off the kernel's throughput — with k/v rotating in the raw
+    (bf16) dtype, halving ICI bytes per hop."""
     P = n_shards
     my = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     cs = sq // 2
+    pallas = _use_pallas_hops(use_pallas, cs)
+    interpret = jax.default_backend() in ("cpu",)
     nc = cs // _pick_block(cs, block_q)
     f32 = jnp.float32
-    qh = q.transpose(0, 2, 1, 3).astype(f32) * scale        # [b, h, sq, d]
-    kb = k.transpose(0, 2, 1, 3).astype(f32)
-    vb = v.transpose(0, 2, 1, 3).astype(f32)
-    qe, ql = qh[:, :, :cs], qh[:, :, cs:]
     rows = jnp.arange(cs)
+    if pallas:
+        qh = q.transpose(0, 2, 1, 3)                        # RAW, unscaled
+        kb = k.transpose(0, 2, 1, 3)
+        vb = v.transpose(0, 2, 1, 3)
+
+        def pair(qs, ks, vs, m, l, a, tri):
+            return _pair_fwd_pallas(qs, ks, vs, m, l, a, tri, scale,
+                                    interpret)
+    else:
+        qh = q.transpose(0, 2, 1, 3).astype(f32) * scale    # [b, h, sq, d]
+        kb = k.transpose(0, 2, 1, 3).astype(f32)
+        vb = v.transpose(0, 2, 1, 3).astype(f32)
+
+        def pair(qs, ks, vs, m, l, a, tri):
+            return _hop_fwd(qs, ks, vs, m, l, a, rows, rows, tri, nc)
+    qe, ql = qh[:, :, :cs], qh[:, :, cs:]
     m_e = jnp.full((b, h, cs), _NEG_INF, f32)
     m_l = jnp.full((b, h, cs), _NEG_INF, f32)
     l_e = jnp.zeros((b, h, cs), f32)
@@ -300,20 +385,18 @@ def _zz_forward(axis_name, n_shards, scale, block_q, q, k, v):
         ve, vl = vb[:, :, :cs], vb[:, :, cs:]
         if j == 0:
             # both triangular diagonal pairs, batched into one matmul
-            md, ld, ad = _hop_fwd(
+            md, ld, ad = pair(
                 jnp.concatenate([qe, ql], 0), jnp.concatenate([ke, kl], 0),
                 jnp.concatenate([ve, vl], 0), jnp.concatenate([m_e, m_l], 0),
                 jnp.concatenate([l_e, l_l], 0), jnp.concatenate([a_e, a_l], 0),
-                rows, rows, True, nc)
+                True)
             m_e, m_l = md[:b], md[b:]
             l_e, l_l = ld[:b], ld[b:]
             a_e, a_l = ad[:b], ad[b:]
-            m_l, l_l, a_l = _hop_fwd(ql, ke, ve, m_l, l_l, a_l, rows, rows,
-                                     False, nc)
+            m_l, l_l, a_l = pair(ql, ke, ve, m_l, l_l, a_l, False)
         else:
             # q_late x k_early: always fully live
-            m_l, l_l, a_l = _hop_fwd(ql, ke, ve, m_l, l_l, a_l, rows, rows,
-                                     False, nc)
+            m_l, l_l, a_l = pair(ql, ke, ve, m_l, l_l, a_l, False)
             # exactly one of q_early x k_early (d >= j) / q_late x k_late
             cond = my >= j
             q_s = jnp.where(cond, qe, ql)
@@ -322,8 +405,7 @@ def _zz_forward(axis_name, n_shards, scale, block_q, q, k, v):
             m_s = jnp.where(cond, m_e, m_l)
             l_s = jnp.where(cond, l_e, l_l)
             a_s = jnp.where(cond, a_e, a_l)
-            m2, l2, a2 = _hop_fwd(q_s, k_s, v_s, m_s, l_s, a_s, rows, rows,
-                                  False, nc)
+            m2, l2, a2 = pair(q_s, k_s, v_s, m_s, l_s, a_s, False)
             m_e = jnp.where(cond, m2, m_e)
             l_e = jnp.where(cond, l2, l_e)
             a_e = jnp.where(cond, a2, a_e)
@@ -343,14 +425,16 @@ def _zz_forward(axis_name, n_shards, scale, block_q, q, k, v):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _zz_core(axis_name, n_shards, scale, block_q, q, k, v):
-    out, _ = _zz_forward(axis_name, n_shards, scale, block_q, q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _zz_core(axis_name, n_shards, scale, block_q, use_pallas, q, k, v):
+    out, _ = _zz_forward(axis_name, n_shards, scale, block_q, use_pallas,
+                         q, k, v)
     return out
 
 
-def _zz_fwd_rule(axis_name, n_shards, scale, block_q, q, k, v):
-    out, lse = _zz_forward(axis_name, n_shards, scale, block_q, q, k, v)
+def _zz_fwd_rule(axis_name, n_shards, scale, block_q, use_pallas, q, k, v):
+    out, lse = _zz_forward(axis_name, n_shards, scale, block_q, use_pallas,
+                           q, k, v)
     return out, (q, k, v, out, lse)
 
 
@@ -391,22 +475,38 @@ def _zz_bwd_block(qh_r, do_r, delta_r, lse_r, k_blk, v_blk, tri, nc, scale):
     return _unchunk(dqs), dk, dv
 
 
-def _zz_bwd_rule(axis_name, n_shards, scale, block_q, res, dout):
+def _zz_bwd_rule(axis_name, n_shards, scale, block_q, use_pallas, res, dout):
     """Zigzag memory-efficient backward: (k, v, dk, dv) rotate together,
-    each hop recomputes only its two live chunk pairs."""
+    each hop recomputes only its two live chunk pairs — through the pallas
+    flash backward kernels on TPU (``_pair_bwd_pallas``; global lse/delta
+    make per-hop contributions exact), the XLA chunk scans elsewhere."""
     q, k, v, out, lse = res
     P = n_shards
     my = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     cs = sq // 2
+    pallas = _use_pallas_hops(use_pallas, cs)
+    interpret = jax.default_backend() in ("cpu",)
     nc = cs // _pick_block(cs, block_q)
     f32 = jnp.float32
-    qh = q.transpose(0, 2, 1, 3).astype(f32) * scale
-    kb = k.transpose(0, 2, 1, 3).astype(f32)
-    vb = v.transpose(0, 2, 1, 3).astype(f32)
-    do = dout.transpose(0, 2, 1, 3).astype(f32)
+    if pallas:
+        qh = q.transpose(0, 2, 1, 3)                        # RAW, unscaled
+        kb = k.transpose(0, 2, 1, 3)
+        vb = v.transpose(0, 2, 1, 3)
+        do = dout.transpose(0, 2, 1, 3)
+    else:
+        qh = q.transpose(0, 2, 1, 3).astype(f32) * scale
+        kb = k.transpose(0, 2, 1, 3).astype(f32)
+        vb = v.transpose(0, 2, 1, 3).astype(f32)
+        do = dout.transpose(0, 2, 1, 3).astype(f32)
     ot = out.transpose(0, 2, 1, 3).astype(f32)
-    delta = jnp.sum(do * ot, -1)                            # [b, h, sq]
+    delta = jnp.sum(do.astype(f32) * ot, -1)                # [b, h, sq]
+
+    def pair_bwd(q_r, do_r, d_r, lse_r, k_s, v_s, tri):
+        if pallas:
+            return _pair_bwd_pallas(q_r, do_r, d_r, lse_r, k_s, v_s, tri,
+                                    scale, interpret)
+        return _zz_bwd_block(q_r, do_r, d_r, lse_r, k_s, v_s, tri, nc, scale)
     qe, ql = qh[:, :, :cs], qh[:, :, cs:]
     doe, dol = do[:, :, :cs], do[:, :, cs:]
     de, dl = delta[:, :, :cs], delta[:, :, cs:]
@@ -423,23 +523,21 @@ def _zz_bwd_rule(axis_name, n_shards, scale, block_q, res, dout):
         dke, dkl = dkb[:, :, :cs], dkb[:, :, cs:]
         dve, dvl = dvb[:, :, :cs], dvb[:, :, cs:]
         if j == 0:
-            dq_d, dk_d, dv_d = _zz_bwd_block(
+            dq_d, dk_d, dv_d = pair_bwd(
                 jnp.concatenate([qe, ql], 0), jnp.concatenate([doe, dol], 0),
                 jnp.concatenate([de, dl], 0),
                 jnp.concatenate([lse_e, lse_l], 0),
                 jnp.concatenate([ke, kl], 0), jnp.concatenate([ve, vl], 0),
-                True, nc, scale)
+                True)
             dq_e = dq_e + dq_d[:b]
             dq_l = dq_l + dq_d[b:]
             dke, dkl = dke + dk_d[:b], dkl + dk_d[b:]
             dve, dvl = dve + dv_d[:b], dvl + dv_d[b:]
-            dq2, dk2, dv2 = _zz_bwd_block(ql, dol, dl, lse_l, ke, ve,
-                                          False, nc, scale)
+            dq2, dk2, dv2 = pair_bwd(ql, dol, dl, lse_l, ke, ve, False)
             dq_l = dq_l + dq2
             dke, dve = dke + dk2, dve + dv2
         else:
-            dq2, dk2, dv2 = _zz_bwd_block(ql, dol, dl, lse_l, ke, ve,
-                                          False, nc, scale)
+            dq2, dk2, dv2 = pair_bwd(ql, dol, dl, lse_l, ke, ve, False)
             dq_l = dq_l + dq2
             dke, dve = dke + dk2, dve + dv2
             cond = my >= j
@@ -449,8 +547,7 @@ def _zz_bwd_rule(axis_name, n_shards, scale, block_q, res, dout):
             lse_s = jnp.where(cond, lse_e, lse_l)
             k_s = jnp.where(cond, ke, kl)
             v_s = jnp.where(cond, ve, vl)
-            dq3, dk3, dv3 = _zz_bwd_block(q_s, do_s, d_s, lse_s, k_s, v_s,
-                                          False, nc, scale)
+            dq3, dk3, dv3 = pair_bwd(q_s, do_s, d_s, lse_s, k_s, v_s, False)
             dq_e = jnp.where(cond, dq_e + dq3, dq_e)
             dq_l = jnp.where(cond, dq_l, dq_l + dq3)
             dke = jnp.where(cond, dke + dk3, dke)
@@ -480,12 +577,18 @@ _zz_core.defvjp(_zz_fwd_rule, _zz_bwd_rule)
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "sequence", causal: bool = True,
                    scale: typing.Optional[float] = None,
-                   block_q: int = 512) -> jax.Array:
+                   block_q: int = 512,
+                   use_pallas: typing.Optional[bool] = None) -> jax.Array:
     """q, k, v: [batch, seq, heads, d] (global); returns same shape.
 
     Sharding: seq over ``axis_name``; batch over 'data' and heads over
     'model' when those axes exist in the mesh.  Differentiable with
     O(seq/P · d) residual memory (see module docstring).
+
+    ``use_pallas``: route zigzag hop pairs through the pallas flash
+    kernels (None = auto: TPU yes, CPU no, ``HBNLP_RING_XLA=1`` forces the
+    XLA chunk scans); tests pass True to exercise the kernel path in
+    interpret mode.
     """
     n_shards = mesh.shape[axis_name]
     if scale is None:
@@ -502,7 +605,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             qz = _to_zigzag(q, axis_name, n_shards)
             kz = _to_zigzag(k, axis_name, n_shards)
             vz = _to_zigzag(v, axis_name, n_shards)
-            out = _zz_core(axis_name, n_shards, scale, block_q, qz, kz, vz)
+            out = _zz_core(axis_name, n_shards, scale, block_q, use_pallas,
+                           qz, kz, vz)
             return _from_zigzag(out, axis_name, n_shards)
 
         fn = jax.shard_map(zz_fn, mesh=mesh, in_specs=(spec, spec, spec),
