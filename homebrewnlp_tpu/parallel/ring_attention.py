@@ -40,6 +40,14 @@ exactly one of q_early x k_early (device d >= j) or q_late x k_late
 j=0 runs the two triangular diagonal pairs (batched into one matmul) plus
 q_late x k_early.  Useful-FLOP fraction goes from ~50% to ~100% of what is
 computed, halving attention cost at the same balance.
+
+On TPU the zigzag hop pairs run the pallas flash kernels
+(parallel/flash_attention.py flat cores) rather than the XLA chunk scans:
+the forward merges each pair's normalized (out, lse) by log-sum-exp
+arithmetic, the backward feeds the GLOBAL lse/delta so per-hop pieces
+accumulate exactly, and k/v rotate in the raw (bf16) dtype — half the ICI
+bytes.  ``HBNLP_RING_XLA=1`` or ``use_pallas=False`` keeps the scan path
+(CPU default; also the pod-scale A/B lever, docs/PERFORMANCE.md round 4b).
 """
 from __future__ import annotations
 
