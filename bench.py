@@ -203,7 +203,28 @@ def main() -> int:
         out["mfu"] = round(mfu_frac, 4)
     if val_loss is not None:
         out["val_loss"] = round(val_loss, 4)
-    print(json.dumps(out))
+    # the headline line goes out NOW: the companion's 16k compile can kill
+    # the PROCESS (worker crash / OOM), which no except clause survives — a
+    # consumer taking the last JSON line sees the enriched line when the
+    # companion succeeds and this one when it dies
+    print(json.dumps(out), flush=True)
+
+    # long-context companion measurement (seq 16,384 on TPU; shrunk on CPU —
+    # its 'metric' string names the actual sequence length): the flagship
+    # line alone would hide the framework's long-context throughput
+    # (BASELINE.md 'Long context')
+    try:
+        state = trainer = batches = None  # free HBM before the 16k compile
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+        import bench_long_context as lc
+        lc_out = lc.run()
+        out["long_context_tokens_per_sec_chip"] = lc_out["value"]
+        out["long_context_metric"] = lc_out["metric"]
+        if "mfu" in lc_out:
+            out["long_context_mfu"] = lc_out["mfu"]
+        print(json.dumps(out))
+    except Exception as exc:
+        print(f"long-context companion bench failed: {exc}", file=sys.stderr)
     return 0
 
 

@@ -55,7 +55,15 @@ def main() -> int:
                          " or the kept XLA-scan fallback")
     ap.add_argument("--seq", type=int, default=16384)
     args = ap.parse_args()
-    if args.bwd == "xla":
+    out = run(bwd=args.bwd, seq=args.seq)
+    print(json.dumps(out))
+    return 0
+
+
+def run(bwd: str = "pallas", seq: int = 16384) -> dict:
+    """Measure and return the result dict (bench.py rides these keys on its
+    headline JSON line; the CLI path prints them)."""
+    if bwd == "xla":
         os.environ["HBNLP_FLASH_BWD_XLA"] = "1"
 
     import numpy as np
@@ -65,9 +73,9 @@ def main() -> int:
     from homebrewnlp_tpu.model import Model
     from homebrewnlp_tpu.train import Trainer
 
-    cfg = dict(LC_CONFIG, sequence_length=args.seq)
+    cfg = dict(LC_CONFIG, sequence_length=seq)
     if jax.default_backend() == "cpu":
-        cfg.update(sequence_length=min(args.seq, 2048), depth=2,
+        cfg.update(sequence_length=min(seq, 2048), depth=2,
                    features_per_head=64, heads=2,
                    calculation_dtype="float32", storage_dtype="float32")
 
@@ -116,11 +124,10 @@ def main() -> int:
     out = {"metric": f"LM tokens/sec/chip @ {params.sequence_length}-ctx "
                      "long-context",
            "value": round(tok_s, 2), "unit": "tokens/sec/chip",
-           "flash_bwd": args.bwd}
+           "flash_bwd": bwd}
     if mfu_frac is not None:
         out["mfu"] = mfu_frac
-    print(json.dumps(out))
-    return 0
+    return out
 
 
 if __name__ == "__main__":
