@@ -1,7 +1,10 @@
 #!/usr/bin/env python3
 """Headline benchmark: LM tokens/sec/chip on the 32big_mixer recipe.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints the headline JSON line {"metric", "value", "unit", "vs_baseline"}
+first, then (on success) ONE enriched line adding the long-context
+companion keys — consumers should take the LAST line; the early headline
+only survives alone if the companion's 16k compile kills the process.
 
 The architecture matches configs/32big_mixer.json of the reference
 (/root/reference/configs/32big_mixer.json: seq 512, 8 heads x 512

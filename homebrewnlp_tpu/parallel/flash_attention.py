@@ -395,17 +395,24 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal, block_q,
     return back(dq), back(dk), back(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, scale: float = None, causal: bool = True,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False):
-    """q, k, v: [batch, seq, heads, d] -> [batch, seq, heads, d]."""
+                    interpret: bool = False, bwd_block_q: int = None,
+                    bwd_block_k: int = None):
+    """q, k, v: [batch, seq, heads, d] -> [batch, seq, heads, d].
+
+    ``bwd_block_q``/``bwd_block_k`` override the backward kernels' tiles
+    (None = same as forward): the forward profits from a wider k tile
+    (fewer online-softmax rescale steps) that pushes the dq kernel past the
+    scoped-VMEM limit in the full model."""
     out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k,
                              interpret)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               bwd_block_q, bwd_block_k):
     out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k,
                                interpret)
     return out, (q, k, v, out, lse)
@@ -456,13 +463,16 @@ def _flash_bwd_xla(scale, causal, block_q, res, dout):
             back(dv).astype(v.dtype))
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, dout):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, bwd_block_q,
+               bwd_block_k, res, dout):
     import os
+    bq = block_q if bwd_block_q is None else bwd_block_q
+    bk = block_k if bwd_block_k is None else bwd_block_k
     if os.environ.get("HBNLP_FLASH_BWD_XLA"):
-        return _flash_bwd_xla(scale, causal, block_q, res, dout)
+        return _flash_bwd_xla(scale, causal, bq, res, dout)
     q, k, v, out, lse = res
     return _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal,
-                             block_q, block_k, interpret)
+                             bq, bk, interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -472,13 +482,16 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
               causal: bool = True, interpret: typing.Optional[bool] = None):
     """Dispatch: pallas kernel on TPU, fused XLA elsewhere.
 
-    Block sizes (both passes): the largest power-of-two divisor of the
-    sequence up to 1024 (always terminates at 128 given the s % 128 gate).
-    Measured on v5e at s=16384, d=128 (in-jit loop): 128x128 tiles are
-    grid-overhead/HBM-read bound (round-4 fix, 27x); with the
-    diagonal-split kernels, 1024 tiles run the causal forward 38% faster
-    than 512 (14.8 vs 24.0 ms) — the forward is VPU-bound on softmax
-    bookkeeping, and bigger tiles amortise the per-cell state ops."""
+    Block sizes (both passes): the largest power-of-two divisors of the
+    sequence up to 1024 for q and 2048 for k (always terminating at 128
+    given the s % 128 gate).  Measured on v5e at s=16384, d=128 (in-jit
+    loop): 128x128 tiles are grid-overhead/HBM-read bound (round-4 fix,
+    27x); with the diagonal-split kernels the forward is VPU-bound on
+    softmax bookkeeping, so bigger tiles amortise the per-cell state ops —
+    1024x1024 beats 512x512 by 38%, and widening the FORWARD's k tile to
+    2048 (fewer online-softmax rescale steps per q row) another 26%; the
+    backward keeps 1024x1024 — measured neutral at wider k standalone, and
+    the dq kernel exceeds the in-model scoped-VMEM limit there."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -488,4 +501,6 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     if not on_tpu or s % 128 != 0:
         return _xla_reference(q, k, v, scale, causal)
     blk = kernel_block(s)
-    return flash_attention(q, k, v, scale, causal, blk, blk, False)
+    return flash_attention(q, k, v, scale, causal, blk,
+                           kernel_block(s, cap=2048), interpret,
+                           bwd_block_q=blk, bwd_block_k=blk)
