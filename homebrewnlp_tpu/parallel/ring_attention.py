@@ -308,11 +308,13 @@ def _pair_fwd_pallas(qp, k_blk, v_blk, m, l, acc, tri, scale, interpret):
     out_h·exp(lse_h - m2) with mass exp(lse_h - m2)."""
     from .flash_attention import _fwd_flat, kernel_block
     b, h, cs, d = qp.shape
-    blk = kernel_block(cs)
+    # same asymmetric tiles as the single-chip forward dispatch: wider k
+    # halves the per-k-block online-softmax state updates (attention())
     out_h, lse_h = _fwd_flat(qp.reshape(b * h, cs, d),
                              k_blk.reshape(b * h, cs, d),
                              v_blk.reshape(b * h, cs, d),
-                             scale, tri, blk, blk, interpret,
+                             scale, tri, kernel_block(cs),
+                             kernel_block(cs, cap=2048), interpret,
                              out_dtype=jnp.float32)
     out_h = out_h.reshape(b, h, cs, d)
     lse_h = lse_h.reshape(b, h, cs)
