@@ -225,7 +225,7 @@ def main() -> int:
         out["long_context_metric"] = lc_out["metric"]
         if "mfu" in lc_out:
             out["long_context_mfu"] = lc_out["mfu"]
-        print(json.dumps(out))
+        print(json.dumps(out), flush=True)
     except Exception as exc:
         print(f"long-context companion bench failed: {exc}", file=sys.stderr)
     return 0
