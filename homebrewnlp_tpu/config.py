@@ -214,6 +214,16 @@ class ModelParameter:
         # pallas flash kernel for plain softmax dot-product attention
         # (single-device; map-bias flags and decode use the dense path)
         self.use_flash_attention = True
+        # stash each flash layer's (out, lse) during the forward so the
+        # revnet/momentum backward's recompute skips the forward kernel
+        # (model/blocks.py stash channels + flash_precomputed).  Opt-in:
+        # costs depth x [batch, seq, heads, d] extra residents — a clear
+        # win where attention dominates (long context, ~+30% of the 16k
+        # step was recompute-forward kernels), a poor trade at flagship
+        # shapes (4+ GB at batch 32).  Single-device flash path only:
+        # sequence-parallel recipes route through ring attention, which
+        # does not consume the stash — the flag is a no-op there.
+        self.stash_attention_outputs = False
         # lax.scan unroll factor for the depth scan (XLA overlap vs memory)
         self.scan_unroll = 1
         self.gradient_checkpointing_policy = "nothing_saveable"

@@ -58,7 +58,8 @@ class ReplayBlock:
         return isinstance(other, ReplayBlock) and self._key == other._key
 
     def __call__(self, subset: Subset, x: NamedTensor,
-                 it: typing.Optional[jax.Array] = None) -> NamedTensor:
+                 it: typing.Optional[jax.Array] = None,
+                 attn_stash: typing.Optional[dict] = None) -> NamedTensor:
         outer_rng = None
         outer_mesh = None
         outer_decode = None
@@ -74,6 +75,10 @@ class ReplayBlock:
                             mesh=outer_mesh, decode=outer_decode)
         ctx.prefill = outer_prefill
         ctx.stats_sink = outer_sink
+        # attention-output stash channel (collect/provide), handed EXPLICITLY
+        # by the strategy code — never inherited from the outer context, so
+        # a mode can't leak across custom_vjp replay boundaries
+        ctx.attn_stash = attn_stash
         if outer_rng is not None:
             # `it` is the (possibly traced) depth index under scan-over-layers
             idx = self.depth_idx if it is None else it
@@ -104,26 +109,60 @@ def _block_scope_name(depth_idx: int, cfg_idx: int) -> str:
 
 # ---- reversible sequence -------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def rev_sequence(fns, subsets, x1, x2):
+def _call_block(f, subset, x, it=None, chan=None):
+    """Invoke a block, passing only the kwargs in use — plain test callables
+    (and the pipeline's stage fns) keep their two-arg signature."""
+    kwargs = {}
+    if it is not None:
+        kwargs["it"] = it
+    if chan is not None:
+        kwargs["attn_stash"] = chan
+    return f(subset, x, **kwargs)
+
+
+def _collect_chan(stash: bool):
+    return {"mode": "collect", "items": []} if stash else None
+
+
+def _provide_chan(stash: bool, items):
+    """items: the block's stashed (out, lse) tuples from the forward rule's
+    residuals; an empty tuple (no flash calls in the block) degrades to the
+    plain replay."""
+    if not stash or not items:
+        return None
+    return {"mode": "provide", "items": list(items), "i": 0}
+
+
+def _chan_items(chan):
+    return tuple(chan["items"]) if chan is not None else ()
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 4))
+def rev_sequence(fns, subsets, x1, x2, stash: bool = False):
     for f, s in zip(fns, subsets):
         x1, x2 = x2, x1 + f(s, x2)
     return x1, x2
 
 
-def _rev_fwd(fns, subsets, x1, x2):
-    out = rev_sequence(fns, subsets, x1, x2)
-    return out, (subsets, out)
+def _rev_fwd(fns, subsets, x1, x2, stash):
+    stashes = []
+    for f, s in zip(fns, subsets):
+        chan = _collect_chan(stash)
+        x1, x2 = x2, x1 + _call_block(f, s, x2, chan=chan)
+        stashes.append(_chan_items(chan))
+    return (x1, x2), (subsets, (x1, x2), tuple(stashes))
 
 
-def _rev_bwd(fns, res, cot):
-    subsets, (a, b) = res
+def _rev_bwd(fns, stash, res, cot):
+    subsets, (a, b), stashes = res
     da, db = cot
     dsubsets: typing.List[typing.Any] = [None] * len(fns)
     for i in range(len(fns) - 1, -1, -1):
         f, s = fns[i], subsets[i]
         b_prev = a
-        fval, fvjp = jax.vjp(f, s, b_prev)
+        chan = _provide_chan(stash, stashes[i])
+        fval, fvjp = jax.vjp(
+            lambda s_, x_: _call_block(f, s_, x_, chan=chan), s, b_prev)
         a_prev = b - fval
         ds, db_extra = fvjp(db)
         da_prev = db
@@ -139,27 +178,34 @@ rev_sequence.defvjp(_rev_fwd, _rev_bwd)
 
 # ---- invertible momentum sequence ---------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def momentum_sequence(fns, alpha, subsets, x, v):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5))
+def momentum_sequence(fns, alpha, subsets, x, v, stash: bool = False):
     for f, s in zip(fns, subsets):
         v = v * alpha + f(s, x) * (1 - alpha)
         x = x + v
     return x, v
 
 
-def _mom_fwd(fns, alpha, subsets, x, v):
-    out = momentum_sequence(fns, alpha, subsets, x, v)
-    return out, (subsets, out)
+def _mom_fwd(fns, alpha, subsets, x, v, stash):
+    stashes = []
+    for f, s in zip(fns, subsets):
+        chan = _collect_chan(stash)
+        v = v * alpha + _call_block(f, s, x, chan=chan) * (1 - alpha)
+        x = x + v
+        stashes.append(_chan_items(chan))
+    return (x, v), (subsets, (x, v), tuple(stashes))
 
 
-def _mom_bwd(fns, alpha, res, cot):
-    subsets, (x, v) = res
+def _mom_bwd(fns, alpha, stash, res, cot):
+    subsets, (x, v), stashes = res
     dx, dv = cot
     dsubsets: typing.List[typing.Any] = [None] * len(fns)
     for i in range(len(fns) - 1, -1, -1):
         f, s = fns[i], subsets[i]
         x_prev = x - v
-        fval, fvjp = jax.vjp(f, s, x_prev)
+        chan = _provide_chan(stash, stashes[i])
+        fval, fvjp = jax.vjp(
+            lambda s_, x_: _call_block(f, s_, x_, chan=chan), s, x_prev)
         v_prev = (v - fval * (1 - alpha)) / alpha
         g = dx + dv  # total cotangent on v' (it feeds both outputs)
         ds, dx_f = fvjp(g * (1 - alpha))  # f enters v' scaled by (1 - alpha)
@@ -186,44 +232,52 @@ momentum_sequence.defvjp(_mom_fwd, _mom_bwd)
 # scan carry.  Enabled by `scan_layers` (default on) whenever the stack is
 # depth-homogeneous; anything irregular falls back to the unrolled forms.
 
-def _iter_body(fns, shared, x1, x2, sl, it):
-    for f, stk, shr in zip(fns, sl, shared):
-        x1, x2 = x2, x1 + f({**stk, **shr}, x2, it=it)
-    return x1, x2
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def rev_scan(fns, unroll, stacked, shared, x1, x2):
+def _rev_scan_run(fns, unroll, stacked, shared, x1, x2, stash):
     def step(carry, sl):
         x1, x2, it = carry
-        x1, x2 = _iter_body(fns, shared, x1, x2, sl, it)
-        return (x1, x2, it + 1), None
+        outs = []
+        for c, f in enumerate(fns):
+            chan = _collect_chan(stash)
+            x1, x2 = x2, x1 + _call_block(f, {**sl[c], **shared[c]}, x2,
+                                          it=it, chan=chan)
+            outs.append(_chan_items(chan))
+        return (x1, x2, it + 1), tuple(outs)
 
-    (x1, x2, _), _ = jax.lax.scan(step, (x1, x2, jnp.int32(0)), stacked,
-                                  unroll=unroll)
+    (x1, x2, _), stashes = jax.lax.scan(step, (x1, x2, jnp.int32(0)), stacked,
+                                        unroll=unroll)
+    return x1, x2, stashes
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 6))
+def rev_scan(fns, unroll, stacked, shared, x1, x2, stash: bool = False):
+    x1, x2, _ = _rev_scan_run(fns, unroll, stacked, shared, x1, x2, False)
     return x1, x2
 
 
-def _rev_scan_fwd(fns, unroll, stacked, shared, x1, x2):
-    out = rev_scan(fns, unroll, stacked, shared, x1, x2)
-    return out, (stacked, shared, out)
+def _rev_scan_fwd(fns, unroll, stacked, shared, x1, x2, stash):
+    x1, x2, stashes = _rev_scan_run(fns, unroll, stacked, shared, x1, x2,
+                                    stash)
+    return (x1, x2), (stacked, shared, (x1, x2), stashes)
 
 
-def _rev_scan_bwd(fns, unroll, res, cot):
-    stacked, shared, (a, b) = res
+def _rev_scan_bwd(fns, unroll, stash, res, cot):
+    stacked, shared, (a, b), stashes = res
     da, db = cot
     depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
     zero_shared = jax.tree_util.tree_map(jnp.zeros_like, shared)
 
     def back(carry, sl):
+        sl_params, sl_stash = sl
         a, b, da, db, dshared, it = carry
         ds_out: typing.List[typing.Any] = [None] * len(fns)
         dshared_new = list(dshared)
         for c in range(len(fns) - 1, -1, -1):
-            f, stk, shr = fns[c], sl[c], shared[c]
+            f, stk, shr = fns[c], sl_params[c], shared[c]
             b_prev = a
+            chan = _provide_chan(stash, sl_stash[c])
             fval, fvjp = jax.vjp(
-                lambda stk_, shr_, x_: f({**stk_, **shr_}, x_, it=it),
+                lambda stk_, shr_, x_: _call_block(f, {**stk_, **shr_}, x_,
+                                                   it=it, chan=chan),
                 stk, shr, b_prev)
             a_prev = b - fval
             dstk, dshr, db_extra = fvjp(db)
@@ -236,47 +290,61 @@ def _rev_scan_bwd(fns, unroll, res, cot):
 
     carry0 = (a, b, da, db, zero_shared, jnp.int32(depth - 1))
     (_, _, da, db, dshared, _), ds_stacked = jax.lax.scan(
-        back, carry0, stacked, reverse=True, unroll=unroll)
+        back, carry0, (stacked, stashes), reverse=True, unroll=unroll)
     return ds_stacked, dshared, da, db
 
 
 rev_scan.defvjp(_rev_scan_fwd, _rev_scan_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def momentum_scan(fns, alpha, unroll, stacked, shared, x, v):
+def _mom_scan_run(fns, alpha, unroll, stacked, shared, x, v, stash):
     def step(carry, sl):
         x, v, it = carry
-        for f, stk, shr in zip(fns, sl, shared):
-            v = v * alpha + f({**stk, **shr}, x, it=it) * (1 - alpha)
+        outs = []
+        for c, f in enumerate(fns):
+            chan = _collect_chan(stash)
+            v = v * alpha + _call_block(f, {**sl[c], **shared[c]}, x,
+                                        it=it, chan=chan) * (1 - alpha)
             x = x + v
-        return (x, v, it + 1), None
+            outs.append(_chan_items(chan))
+        return (x, v, it + 1), tuple(outs)
 
-    (x, v, _), _ = jax.lax.scan(step, (x, v, jnp.int32(0)), stacked,
-                                unroll=unroll)
+    (x, v, _), stashes = jax.lax.scan(step, (x, v, jnp.int32(0)), stacked,
+                                      unroll=unroll)
+    return x, v, stashes
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 7))
+def momentum_scan(fns, alpha, unroll, stacked, shared, x, v,
+                  stash: bool = False):
+    x, v, _ = _mom_scan_run(fns, alpha, unroll, stacked, shared, x, v, False)
     return x, v
 
 
-def _mom_scan_fwd(fns, alpha, unroll, stacked, shared, x, v):
-    out = momentum_scan(fns, alpha, unroll, stacked, shared, x, v)
-    return out, (stacked, shared, out)
+def _mom_scan_fwd(fns, alpha, unroll, stacked, shared, x, v, stash):
+    x, v, stashes = _mom_scan_run(fns, alpha, unroll, stacked, shared, x, v,
+                                  stash)
+    return (x, v), (stacked, shared, (x, v), stashes)
 
 
-def _mom_scan_bwd(fns, alpha, unroll, res, cot):
-    stacked, shared, (x, v) = res
+def _mom_scan_bwd(fns, alpha, unroll, stash, res, cot):
+    stacked, shared, (x, v), stashes = res
     dx, dv = cot
     depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
     zero_shared = jax.tree_util.tree_map(jnp.zeros_like, shared)
 
     def back(carry, sl):
+        sl_params, sl_stash = sl
         x, v, dx, dv, dshared, it = carry
         ds_out: typing.List[typing.Any] = [None] * len(fns)
         dshared_new = list(dshared)
         for c in range(len(fns) - 1, -1, -1):
-            f, stk, shr = fns[c], sl[c], shared[c]
+            f, stk, shr = fns[c], sl_params[c], shared[c]
             x_prev = x - v
+            chan = _provide_chan(stash, sl_stash[c])
             fval, fvjp = jax.vjp(
-                lambda stk_, shr_, x_: f({**stk_, **shr_}, x_, it=it),
+                lambda stk_, shr_, x_: _call_block(f, {**stk_, **shr_}, x_,
+                                                   it=it, chan=chan),
                 stk, shr, x_prev)
             v_prev = (v - fval * (1 - alpha)) / alpha
             g = dx + dv
@@ -292,7 +360,7 @@ def _mom_scan_bwd(fns, alpha, unroll, res, cot):
 
     carry0 = (x, v, dx, dv, zero_shared, jnp.int32(depth - 1))
     (_, _, dx, dv, dshared, _), ds_stacked = jax.lax.scan(
-        back, carry0, stacked, reverse=True, unroll=unroll)
+        back, carry0, (stacked, stashes), reverse=True, unroll=unroll)
     return ds_stacked, dshared, dx, dv
 
 
@@ -400,12 +468,14 @@ def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
     if pro is None:
         return None
     stacked, shared, fns = pro
+    stash = bool(getattr(params, "stash_attention_outputs", False))
     if strategy == "revnet":
-        x1, x2 = rev_scan(fns, params.scan_unroll, stacked, shared, src, src)
+        x1, x2 = rev_scan(fns, params.scan_unroll, stacked, shared, src, src,
+                          stash)
         return x1 + x2
     if strategy == "momentum":
         x, v = momentum_scan(fns, params.momentumnet_alpha, params.scan_unroll,
-                             stacked, shared, src, src)
+                             stacked, shared, src, src, stash)
         return x + v
     return _plain_scan(fns, stacked, shared, src, strategy == "checkpoint",
                        params.scan_unroll)
@@ -741,12 +811,13 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         if scanned is not None:
             return scanned, plan
 
+    stash = bool(getattr(params, "stash_attention_outputs", False))
     if strategy == "revnet":
-        x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src)
+        x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src, stash)
         return x1 + x2, plan
     if strategy == "momentum":
         x, v = momentum_sequence(tuple(fns), params.momentumnet_alpha,
-                                 tuple(subsets), src, src)
+                                 tuple(subsets), src, src, stash)
         return x + v, plan
     if strategy == "checkpoint":
         out = src

@@ -160,8 +160,12 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     from ..parallel.flash_attention import attention as flash
 
     if mesh is None:
-        # causal=True always: the dense softmax branch masks unconditionally
-        out = flash(q, k, v, scale=1.0, causal=True)
+        # causal=True always: the dense softmax branch masks unconditionally.
+        # attn_stash: the strategy machinery's attention-output stash channel
+        # (model/blocks.py) — single-device path only; the shard_map branch
+        # keeps the plain kernel
+        out = flash(q, k, v, scale=1.0, causal=True,
+                    stash=getattr(ctx, "attn_stash", None))
     else:
         import jax
         from jax.sharding import PartitionSpec as P

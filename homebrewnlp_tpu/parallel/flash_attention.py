@@ -27,15 +27,26 @@ _NEG_INF = -1e30
 
 
 def _xla_reference(q, k, v, scale, causal):
+    # XLA dead-code-eliminates the unused lse
+    return _xla_reference_with_lse(q, k, v, scale, causal)[0]
+
+
+def _xla_reference_with_lse(q, k, v, scale, causal):
+    """(out, lse [b*h, s]) — the fused XLA form for stash COLLECTION off-TPU
+    (the pallas kernels' residual contract, without interpret-mode cost)."""
+    b, s, h, d = q.shape
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
                         k.astype(jnp.float32))
     if causal:
-        s = q.shape[1]
         mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    m = scores.max(-1)
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30)[..., None],
+                     v.astype(jnp.float32))
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(b * h, s)
+    return out.astype(q.dtype), lse
 
 
 def _causal_split(qi, ki, block_q: int, block_k: int):
@@ -478,9 +489,52 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, bwd_block_q,
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_precomputed(q, k, v, out, lse, scale, causal, block_q, block_k,
+                      interpret):
+    """Flash attention whose forward is the PROVIDED (out, lse) — no kernel
+    run — while the backward is the full flash-2 pallas pass.
+
+    The revnet/momentum backward re-runs each block's forward inside
+    ``jax.vjp`` only to rebuild residuals; with the layer's (out, lse)
+    stashed from the original forward (model/blocks.py ``stash`` strategy
+    variants), forming the attention vjp needs no forward kernel at all —
+    q/k/v come from the replayed (cheap) projections, out/lse from the
+    stash.  The replayed q/k/v differ from the originals by revnet
+    reconstruction ulps, the same approximation class as revnet gradients
+    themselves."""
+    return out
+
+
+def _flash_pre_fwd(q, k, v, out, lse, scale, causal, block_q, block_k,
+                   interpret):
+    return out, (q, k, v, out, lse)
+
+
+def _flash_pre_bwd(scale, causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal,
+                                   block_q, block_k, interpret)
+    # out/lse are stashed residual constants of the OUTER custom_vjp; their
+    # cotangents are discarded upstream
+    return dq, dk, dv, jnp.zeros_like(out), jnp.zeros_like(lse)
+
+
+flash_precomputed.defvjp(_flash_pre_fwd, _flash_pre_bwd)
+
+
 def attention(q, k, v, scale: typing.Optional[float] = None,
-              causal: bool = True, interpret: typing.Optional[bool] = None):
+              causal: bool = True, interpret: typing.Optional[bool] = None,
+              stash: typing.Optional[dict] = None):
     """Dispatch: pallas kernel on TPU, fused XLA elsewhere.
+
+    ``stash``: attention-output stash channel (model/blocks.py): mode
+    "collect" computes (out, lse) and appends them to ``stash["items"]``
+    (the strategy's forward rule saves them as residuals); mode "provide"
+    consumes the next stashed pair and returns ``flash_precomputed`` so the
+    recompute-forward inside the strategy backward never runs the kernel.
+    The gate (s %% 128) is identical in both modes, keeping collect/provide
+    counts symmetric.
 
     Block sizes (both passes): the largest power-of-two divisors of the
     sequence up to 1024 for q and 2048 for k (always terminating at 128
@@ -498,9 +552,23 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     if interpret is None:
         interpret = not on_tpu
     s = q.shape[1]
+    blk = kernel_block(s)
+    if stash is not None and s % 128 == 0:
+        if stash["mode"] == "collect":
+            if on_tpu:
+                out, lse = _flash_fwd_impl(q, k, v, scale, causal, blk,
+                                           kernel_block(s, cap=2048),
+                                           interpret)
+            else:
+                out, lse = _xla_reference_with_lse(q, k, v, scale, causal)
+            stash["items"].append((out, lse))
+            return out
+        out_s, lse_s = stash["items"][stash["i"]]
+        stash["i"] += 1
+        return flash_precomputed(q, k, v, out_s, lse_s, scale, causal,
+                                 blk, blk, interpret)
     if not on_tpu or s % 128 != 0:
         return _xla_reference(q, k, v, scale, causal)
-    blk = kernel_block(s)
     return flash_attention(q, k, v, scale, causal, blk,
                            kernel_block(s, cap=2048), interpret,
                            bwd_block_q=blk, bwd_block_k=blk)

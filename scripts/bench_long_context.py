@@ -40,6 +40,10 @@ LC_CONFIG = {
     "calculation_dtype": "bfloat16", "storage_dtype": "bfloat16",
     "optimizer_slice_dtype": "float32", "slice_dtype": "float32",
     "scan_layers": True, "use_flash_attention": True,
+    # stash (out, lse) per attention layer so the revnet backward's
+    # recompute skips the forward kernel (~520MB extra residents at these
+    # shapes; attention dominates, so it pays — see docs/PERFORMANCE.md)
+    "stash_attention_outputs": True,
     "use_checkpointing": False, "macro_batching": 1,
     "model_path": "/tmp/bench_long_context",
 }

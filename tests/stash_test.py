@@ -1,0 +1,94 @@
+"""Attention-output stashing (stash_attention_outputs).
+
+The revnet/momentum backward re-runs each block's forward inside
+``jax.vjp`` only to rebuild residuals; with stashing, the strategy forward
+rules collect every flash layer's (out, lse) and the backward replay feeds
+them to ``flash_precomputed`` — exact flash-2 gradients with NO forward
+kernel re-execution (measured +23% on the 16k bench, docs/PERFORMANCE.md).
+The replayed q/k/v differ from the originals by revnet-reconstruction
+ulps, so updated parameters match the unstashed run to that tolerance —
+the same approximation class as revnet gradients themselves.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from backend import make_params
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer
+
+
+def _step(stash, strategy, scan, blocks=None, seq=128, seed=0):
+    params = make_params(
+        sequence_length=seq, features_per_head=16, heads=2, depth=2,
+        train_batch_size=2, vocab_size=32,
+        block_config=blocks or [
+            {"layer": ["norm-shift-scale-features-group",
+                       "attention-dot_product-embedded-absolute"]}],
+        memory_reduction_strategy=strategy, scan_layers=scan,
+        use_flash_attention=True, stash_attention_outputs=stash,
+        optimizer="sm3-learning_rate", learning_rate=0.01)
+    model = Model(params)
+    trainer = Trainer(params, model)
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 32, (2, seq, 1))
+    batch = {"token_x": jnp.asarray(x), "token_y": jnp.asarray((x + 1) % 32)}
+    state = trainer.init_state(batch)
+    state, metrics = trainer.step(state, batch)
+    return state, metrics
+
+
+@pytest.mark.parametrize("strategy", ["revnet", "momentum"])
+@pytest.mark.parametrize("scan", [True, False])
+def stash_step_parity_test(strategy, scan):
+    """Same loss, same updated params (to reconstruction ulps) with the
+    stash on vs off, for both strategies, scanned and unrolled."""
+    s0, m0 = _step(False, strategy, scan)
+    s1, m1 = _step(True, strategy, scan)
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                               rtol=1e-6)
+    for n in s0.variables:
+        np.testing.assert_allclose(np.asarray(s0.variables[n]),
+                                   np.asarray(s1.variables[n]),
+                                   rtol=2e-4, atol=1e-5, err_msg=n)
+
+
+def stash_multiple_attention_layers_test():
+    """Two flash calls per block: the per-block stash list must collect and
+    provide in the same order."""
+    blocks = [{"layer": ["norm-shift-scale-features-group",
+                         "attention-dot_product-embedded-absolute",
+                         "attention-dot_product-context-absolute"]}]
+    s0, m0 = _step(False, "revnet", True, blocks=blocks)
+    s1, m1 = _step(True, "revnet", True, blocks=blocks)
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                               rtol=1e-6)
+    for n in s0.variables:
+        np.testing.assert_allclose(np.asarray(s0.variables[n]),
+                                   np.asarray(s1.variables[n]),
+                                   rtol=2e-4, atol=1e-5, err_msg=n)
+
+
+def stash_gate_indivisible_seq_test():
+    """seq not 128-divisible: the symmetric collect/provide gate declines
+    and the plain replay runs — training still works."""
+    _, m = _step(True, "revnet", True, seq=96)
+    assert np.isfinite(float(m["loss"]))
+
+
+def stash_non_flash_block_test():
+    """A block without flash attention stashes an empty tuple; mixing it
+    with attention blocks keeps structures consistent."""
+    blocks = [{"layer": ["norm-shift-scale-features-group",
+                         "feed_forward-in:relu"]},
+              {"layer": ["norm-shift-scale-features-group",
+                         "attention-dot_product-embedded-absolute"]}]
+    s0, m0 = _step(False, "revnet", True, blocks=blocks)
+    s1, m1 = _step(True, "revnet", True, blocks=blocks)
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                               rtol=1e-6)
+    for n in s0.variables:
+        np.testing.assert_allclose(np.asarray(s0.variables[n]),
+                                   np.asarray(s1.variables[n]),
+                                   rtol=2e-4, atol=1e-5, err_msg=n)
