@@ -220,9 +220,10 @@ class ModelParameter:
         # costs depth x [batch, seq, heads, d] extra residents — a clear
         # win where attention dominates (long context, ~+30% of the 16k
         # step was recompute-forward kernels), a poor trade at flagship
-        # shapes (4+ GB at batch 32).  Single-device flash path only:
-        # sequence-parallel recipes route through ring attention, which
-        # does not consume the stash — the flag is a no-op there.
+        # shapes (4+ GB at batch 32).  Consumed by the single-device
+        # flash path AND the sequence-parallel zigzag ring (whose
+        # strategy-backward recompute otherwise re-runs the whole ring,
+        # P hops of kernels and ppermutes, per layer).
         self.stash_attention_outputs = False
         # lax.scan unroll factor for the depth scan (XLA overlap vs memory)
         self.scan_unroll = 1

@@ -137,6 +137,23 @@ def _chan_items(chan):
     return tuple(chan["items"]) if chan is not None else ()
 
 
+def stash_push(chan, item) -> None:
+    """Consumer-side half of the stash-channel contract (collect mode) —
+    the single definition shared by the flash and ring attention paths."""
+    chan["items"].append(item)
+
+
+def stash_pop(chan):
+    """Consumer-side half of the stash-channel contract (provide mode)."""
+    item = chan["items"][chan["i"]]
+    chan["i"] += 1
+    return item
+
+
+def stash_collecting(chan) -> bool:
+    return chan is not None and chan["mode"] == "collect"
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 4))
 def rev_sequence(fns, subsets, x1, x2, stash: bool = False):
     for f, s in zip(fns, subsets):

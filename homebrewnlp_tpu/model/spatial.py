@@ -110,9 +110,13 @@ def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     from ..parallel.ring_attention import ring_attention
 
     # causal=True always: the dense softmax branch masks unconditionally
-    # (reference spatial.py:68), regardless of masked_attention_dimensions
+    # (reference spatial.py:68), regardless of masked_attention_dimensions.
+    # attn_stash: the strategy machinery's attention-output stash channel —
+    # the zigzag ring collects/provides (out, lse) so the strategy
+    # backward's recompute skips the whole ring
     out = ring_attention(q, k, v, mesh, causal=True,
-                         scale=1.0)  # qry already carries the reference scale
+                         scale=1.0,  # qry already carries the reference scale
+                         stash=getattr(ctx, "attn_stash", None))
     out_nt = nt(out.reshape([d.size for d in canonical]), canonical)
     return transpose_to(out_nt, args.tensor.dims)
 

@@ -512,9 +512,16 @@ def _flash_pre_fwd(q, k, v, out, lse, scale, causal, block_q, block_k,
 
 
 def _flash_pre_bwd(scale, causal, block_q, block_k, interpret, res, dout):
+    import os
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal,
-                                   block_q, block_k, interpret)
+    if os.environ.get("HBNLP_FLASH_BWD_XLA"):
+        # the standing backward A/B (scripts/bench_long_context.py --bwd
+        # xla) must route here too — the stash path would otherwise
+        # silently measure the pallas backward under the 'xla' label
+        dq, dk, dv = _flash_bwd_xla(scale, causal, block_q, res, dout)
+    else:
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, dout, scale,
+                                       causal, block_q, block_k, interpret)
     # out/lse are stashed residual constants of the OUTER custom_vjp; their
     # cotangents are discarded upstream
     return dq, dk, dv, jnp.zeros_like(out), jnp.zeros_like(lse)
@@ -554,17 +561,17 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     s = q.shape[1]
     blk = kernel_block(s)
     if stash is not None and s % 128 == 0:
-        if stash["mode"] == "collect":
+        from ..model.blocks import stash_collecting, stash_pop, stash_push
+        if stash_collecting(stash):
             if on_tpu:
                 out, lse = _flash_fwd_impl(q, k, v, scale, causal, blk,
                                            kernel_block(s, cap=2048),
                                            interpret)
             else:
                 out, lse = _xla_reference_with_lse(q, k, v, scale, causal)
-            stash["items"].append((out, lse))
+            stash_push(stash, (out, lse))
             return out
-        out_s, lse_s = stash["items"][stash["i"]]
-        stash["i"] += 1
+        out_s, lse_s = stash_pop(stash)
         return flash_precomputed(q, k, v, out_s, lse_s, scale, causal,
                                  blk, blk, interpret)
     if not on_tpu or s % 128 != 0:

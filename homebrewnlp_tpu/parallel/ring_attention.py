@@ -584,11 +584,44 @@ def _zz_bwd_rule(axis_name, n_shards, scale, block_q, use_pallas, res, dout):
 _zz_core.defvjp(_zz_fwd_rule, _zz_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _zz_core_pre(axis_name, n_shards, scale, block_q, use_pallas, q, k, v,
+                 out, lse):
+    """Zigzag core whose forward IS the provided (out, lse) — no ring run —
+    while the backward is the normal zigzag pass (``_zz_bwd_rule``).
+
+    The attention-output stash (model/blocks.py): the strategy backward
+    re-runs each block's forward only to rebuild residuals, which for the
+    ring means P hops of kernels AND ppermutes; with the per-layer
+    (out, lse) stashed from the original forward, forming the vjp costs
+    nothing.  ``out``/``lse`` arrive zigzag-LOCAL (the caller re-shards the
+    stashed global arrays with the same specs, so the locals round-trip
+    bit-exactly)."""
+    return out
+
+
+def _zz_pre_fwd(axis_name, n_shards, scale, block_q, use_pallas, q, k, v,
+                out, lse):
+    return out, (q, k, v, out, lse)
+
+
+def _zz_pre_bwd(axis_name, n_shards, scale, block_q, use_pallas, res, dout):
+    dq, dk, dv = _zz_bwd_rule(axis_name, n_shards, scale, block_q,
+                              use_pallas, res, dout)
+    # out/lse are stashed residual constants of the OUTER custom_vjp
+    q, k, v, out, lse = res
+    return dq, dk, dv, jnp.zeros_like(out), jnp.zeros_like(lse)
+
+
+_zz_core_pre.defvjp(_zz_pre_fwd, _zz_pre_bwd)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "sequence", causal: bool = True,
                    scale: typing.Optional[float] = None,
                    block_q: int = 512,
-                   use_pallas: typing.Optional[bool] = None) -> jax.Array:
+                   use_pallas: typing.Optional[bool] = None,
+                   stash: typing.Optional[dict] = None) -> jax.Array:
     """q, k, v: [batch, seq, heads, d] (global); returns same shape.
 
     Sharding: seq over ``axis_name``; batch over 'data' and heads over
@@ -599,6 +632,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     kernels (None = auto: TPU yes, CPU no, ``HBNLP_RING_XLA=1`` forces the
     XLA chunk scans); tests pass True to exercise the kernel path in
     interpret mode.
+
+    ``stash``: attention-output stash channel (model/blocks.py) — the
+    zigzag path collects (out, lse-in-zigzag-row-order) globals, and on
+    provide runs ``_zz_core_pre`` so the strategy backward's recompute
+    skips the entire ring (P hops of kernels AND ppermutes).  The gate
+    (the zigzag-path condition) is static, keeping collect/provide counts
+    symmetric; the contiguous fallback ignores the channel.
     """
     n_shards = mesh.shape[axis_name]
     if scale is None:
@@ -611,10 +651,50 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     if causal and n_shards > 1 and seq % (2 * n_shards) == 0:
         # balanced zigzag layout: re-shard (two half-shard ppermutes, one
         # hop's worth of bytes), run the dead-work-free schedule, un-shard
+        lse_spec = P(spec[0], spec[2], axis_name)       # [b, h, seq]
+
+        def to_zz3(q, k, v):
+            return (_to_zigzag(q, axis_name, n_shards),
+                    _to_zigzag(k, axis_name, n_shards),
+                    _to_zigzag(v, axis_name, n_shards))
+
+        if stash is not None:
+            from ..model.blocks import (stash_collecting, stash_pop,
+                                        stash_push)
+        if stash is not None and stash_collecting(stash):
+            def zz_collect(q, k, v):
+                qz, kz, vz = to_zz3(q, k, v)
+                out, lse = _zz_forward(axis_name, n_shards, scale, block_q,
+                                       use_pallas, qz, kz, vz)
+                # out returns in NORMAL row order; lse stays in zigzag row
+                # order (an opaque token — provide re-splits it with the
+                # same spec, so the locals round-trip bit-exactly)
+                return _from_zigzag(out, axis_name, n_shards), lse
+
+            fn = jax.shard_map(zz_collect, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=(spec, lse_spec), check_vma=False)
+            out, lse = fn(q, k, v)
+            stash_push(stash, (out, lse))
+            return out
+
+        if stash is not None:
+            out_s, lse_s = stash_pop(stash)
+
+            def zz_provide(q, k, v, out_g, lse_l):
+                qz, kz, vz = to_zz3(q, k, v)
+                oz = _to_zigzag(out_g, axis_name, n_shards)
+                res = _zz_core_pre(axis_name, n_shards, scale, block_q,
+                                   use_pallas, qz, kz, vz, oz, lse_l)
+                return _from_zigzag(res, axis_name, n_shards)
+
+            fn = jax.shard_map(zz_provide, mesh=mesh,
+                               in_specs=(spec, spec, spec, spec, lse_spec),
+                               out_specs=spec, check_vma=False)
+            return fn(q, k, v, out_s, lse_s)
+
         def zz_fn(q, k, v):
-            qz = _to_zigzag(q, axis_name, n_shards)
-            kz = _to_zigzag(k, axis_name, n_shards)
-            vz = _to_zigzag(v, axis_name, n_shards)
+            qz, kz, vz = to_zz3(q, k, v)
             out = _zz_core(axis_name, n_shards, scale, block_q, use_pallas,
                            qz, kz, vz)
             return _from_zigzag(out, axis_name, n_shards)

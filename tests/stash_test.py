@@ -92,3 +92,49 @@ def stash_non_flash_block_test():
         np.testing.assert_allclose(np.asarray(s0.variables[n]),
                                    np.asarray(s1.variables[n]),
                                    rtol=2e-4, atol=1e-5, err_msg=n)
+
+
+def ring_stash_parity_test():
+    """Sequence-parallel (zigzag ring) stashing: the strategy backward's
+    recompute skips the whole ring — P hops of compute AND ppermutes —
+    when the per-layer (out, lse) are stashed.  Updated params match the
+    unstashed sharded step at reconstruction tolerance."""
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.core import sharding as shardlib
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    def run(stash):
+        params = ModelParameter({
+            "model_mode": "gpt", "use_video": False, "use_language": True,
+            "sequence_length": 64, "features_per_head": 8, "heads": 2,
+            "depth": 2, "train_batch_size": 4, "vocab_size": 32,
+            "memory_reduction_strategy": "revnet",
+            "block_config": [
+                {"layer": ["norm-shift-scale-features-group",
+                           "attention-dot_product-context"]}],
+            "group_linear_factor": 2, "tpu_size": 8,
+            "sequence_parallel": 4,
+            "stash_attention_outputs": stash,
+            "optimizer": "sm3-learning_rate", "learning_rate": 0.01,
+            "weight_decay": 0.0})
+        model = Model(params)
+        mesh = shardlib.build_mesh(params)
+        assert mesh.shape["sequence"] == 4
+        trainer = Trainer(params, model, mesh=mesh)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 32, (4, 64, 1))
+        batch = {"token_x": jnp.asarray(x),
+                 "token_y": jnp.asarray((x + 1) % 32)}
+        state = trainer.init_state(batch)
+        state, metrics = trainer.step(state, batch)
+        return state, metrics
+
+    s0, m0 = run(False)
+    s1, m1 = run(True)
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                               rtol=1e-6)
+    for n in s0.variables:
+        np.testing.assert_allclose(np.asarray(s0.variables[n], np.float32),
+                                   np.asarray(s1.variables[n], np.float32),
+                                   rtol=2e-4, atol=1e-5, err_msg=n)
