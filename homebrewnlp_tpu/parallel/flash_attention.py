@@ -65,12 +65,15 @@ def _causal_split(qi, ki, block_q: int, block_k: int):
 
 
 def _masked_step(qi, ki, block_q: int, block_k: int, causal: bool, score,
-                 accumulate):
-    """Shared causal dispatch for all three kernels: the mask-free interior
+                 accumulate, dead=None):
+    """Shared causal dispatch for the kernels: the mask-free interior
     branch, the masked diagonal branch (mutually exclusive ``pl.when``s —
     the FLOP counter relies on that, utils/flops.py), or the unconditional
     non-causal form.  ``score()`` returns the scaled [bq, bk] logits;
-    ``accumulate(s)`` folds them into the kernel's state."""
+    ``accumulate(s)`` folds them into the kernel's state.  ``dead`` (fused
+    backward only) runs on causally-dead cells — it zero-fills the cell's
+    dq-partial slot so the caller's sum over partials never reads
+    uninitialised memory."""
     from jax.experimental import pallas as pl
 
     if not causal:
@@ -90,6 +93,11 @@ def _masked_step(qi, ki, block_q: int, block_k: int, causal: bool, score,
             jnp.int32, (1, block_k), 1)
         accumulate(jnp.where(q_pos >= k_pos, score(), _NEG_INF))
 
+    if dead is not None:
+        @pl.when(jnp.logical_not(live))
+        def _step_dead():
+            dead()
+
 
 def _frontier_kv_map(block_q: int, block_k: int, causal: bool):
     """K/V BlockSpec index map with dead cells clamped to the causal
@@ -105,6 +113,37 @@ def _frontier_kv_map(block_q: int, block_k: int, causal: bool):
         def kv_map(i, j, kk):
             return (i, kk, 0)
     return kv_map
+
+
+def _frontier_q_map(block_q: int, block_k: int, causal: bool):
+    """Q-side twin of ``_frontier_kv_map`` for the k-outer backward grids
+    (grid (i, k, q) — q innermost): causally-dead q blocks BEFORE the first
+    live one ((kk*bk)//bq, the ``_causal_split`` liveness bound) repeat its
+    index so the pipeline skips the dead HBM fetch."""
+    if causal:
+        def q_map(i, kk, j):
+            return (i, jnp.maximum(j, (kk * block_k) // block_q), 0)
+    else:
+        def q_map(i, kk, j):
+            return (i, j, 0)
+    return q_map
+
+
+def _bwd_tiles(s: int, blk: int):
+    """Backward kernel tiles: the forward tile by default;
+    ``HBNLP_BWD_BQ``/``HBNLP_BWD_BK`` override for retuning on other chips
+    (rounded DOWN to a power-of-two divisor of the sequence — the grids
+    and the ``_causal_split`` liveness arithmetic require block-aligned
+    tiles, so a non-divisor override must not reach the kernels)."""
+    import os
+    bwq = int(os.environ.get("HBNLP_BWD_BQ", 0)) or blk
+    bwk = int(os.environ.get("HBNLP_BWD_BK", 0)) or blk
+    # floor each override to a power of two (kernel_block halves from its
+    # cap, so a non-power-of-two cap would never land on a divisor), then
+    # to a divisor of s, with a floor of 128 (s % 128 == 0 at every caller)
+    floor = kernel_block(s, cap=128)
+    return (max(kernel_block(s, cap=1 << (max(bwq, 1).bit_length() - 1)), floor),
+            max(kernel_block(s, cap=1 << (max(bwk, 1).bit_length() - 1)), floor))
 
 
 def _make_score(q_ref, k_ref, scale):
@@ -306,6 +345,120 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref,
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dqp_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                      block_k: int, num_q: int, scale: float, causal: bool):
+    """Fused backward: grid (b*h, k blocks, q blocks), q innermost.
+
+    The split dq and dk/dv kernels EACH recompute the two shared
+    per-pair tensors p = exp(q·kᵀ − lse) and dp = do·vᵀ — 7 dots + 2 exp
+    per live pair across the two passes.  This kernel computes them once
+    and produces all three gradients in one pass — 5 dots + 1 exp — which
+    also lets the dq contribution ride the MXU work that hides the exp
+    (the standalone dq kernel's 3 dots cannot hide its VPU load; the
+    measured symptom was dq ~27% over its MXU ideal while dk/dv ran
+    saturated).  dk/dv accumulate in VMEM scratch across the inner q
+    sweep exactly as in the split kernel; dq cannot (its blocks change
+    every inner step), so each pair writes its contribution to a per-k
+    PARTIAL buffer [bh, nk, sq, d] that the caller sums over nk —
+    causally-dead cells zero-fill their slot so the sum is garbage-free."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _accumulate(s):
+        # identical dot/rounding structure to the split kernels (numerics
+        # match to f32-accumulation order): p and ds round to the operand
+        # dtype before their MXU dots, accumulation stays f32
+        p = jnp.exp(s - lse_ref[...])
+        dp = jax.lax.dot_general(do_ref[...], v_ref[...],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - d_ref[...]) * scale).astype(q_ref.dtype)
+        dqp_ref[...] = jax.lax.dot_general(
+            ds, k_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _dead():
+        dqp_ref[...] = jnp.zeros_like(dqp_ref)
+
+    _masked_step(qi, ki, block_q, block_k, causal,
+                 _make_score(q_ref, k_ref, scale), _accumulate, dead=_dead)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# dq-partial buffer cap for the fused backward (bytes); above it the split
+# kernels run instead (the buffer is nk x the dq size — negligible for ring
+# hop chunks, ~1GB at the 16k single-chip shape, and quadratic beyond)
+_FUSED_DQP_CAP = 2 * 1024 ** 3
+
+
+def _use_fused_bwd(bh: int, s: int, sk: int, d: int, bk: int) -> bool:
+    import os
+    if os.environ.get("HBNLP_FLASH_BWD_SPLIT"):
+        return False
+    return bh * (sk // bk) * s * d * 4 <= _FUSED_DQP_CAP
+
+
+def _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
+                    interpret, out_dtype=None):
+    """One-pass fused backward (see ``_bwd_fused_kernel``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = qt.shape
+    sk = kt.shape[1]
+    nq, nk = s // bq, sk // bk
+    # per-operand output dtypes, matching the split path exactly (which
+    # path runs is a size decision and must not change output precision)
+    dq_dtype = qt.dtype if out_dtype is None else out_dtype
+    dk_dtype = kt.dtype if out_dtype is None else out_dtype
+    dv_dtype = vt.dtype if out_dtype is None else out_dtype
+
+    _q_map = _frontier_q_map(bq, bk, causal)
+    qrow_spec = pl.BlockSpec((None, bq, 1), _q_map)
+    dqp, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, block_q=bq, block_k=bk,
+                          num_q=nq, scale=scale, causal=causal),
+        grid=(bh, nk, nq),
+        in_specs=[pl.BlockSpec((None, bq, d), _q_map),
+                  pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
+                  pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
+                  pl.BlockSpec((None, bq, d), _q_map),
+                  qrow_spec, qrow_spec],
+        out_specs=[pl.BlockSpec((None, None, bq, d),
+                                lambda i, kk, j: (i, kk, j, 0)),
+                   pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0)),
+                   pl.BlockSpec((None, bk, d), lambda i, kk, j: (i, kk, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, nk, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sk, d), dk_dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), dv_dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse3, delta)
+    dq = dqp.sum(axis=1).astype(dq_dtype)
+    return dq, dk, dv
+
+
 def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
               interpret, out_dtype=None):
     """Flat-core backward: operands [bh, s, d], lse/delta [bh, s, 1] ->
@@ -314,26 +467,27 @@ def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
     correct under any partitioning of the key space, which is what lets
     the ring-attention backward run this same core per hop pair
     (``out_dtype=f32`` there: per-hop grad pieces accumulate across P hops
-    and must not round per hop)."""
+    and must not round per hop).
+
+    Default path: the one-pass FUSED kernel (``_bwd_fused_kernel`` — 5 dots
+    + 1 exp per pair instead of the split kernels' 7 + 2);
+    ``HBNLP_FLASH_BWD_SPLIT=1`` forces the split dq / dk/dv kernels, as
+    does a dq-partial buffer above ``_FUSED_DQP_CAP``."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = qt.shape
     sk = kt.shape[1]
+    if _use_fused_bwd(bh, s, sk, d, bk):
+        return _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal,
+                               bq, bk, interpret, out_dtype)
     nq, nk = s // bq, sk // bk
     dq_dtype = qt.dtype if out_dtype is None else out_dtype
     dk_dtype = kt.dtype if out_dtype is None else out_dtype
     dv_dtype = vt.dtype if out_dtype is None else out_dtype
 
     _kv_map = _frontier_kv_map(bq, bk, causal)
-    if causal:
-        # dkv's q-side twin of _frontier_kv_map (grid (i, k, q) — q
-        # innermost, dead cells BEFORE the first live q block (kk*bk)//bq)
-        def _q_map_dkv(i, kk, j):
-            return (i, jnp.maximum(j, (kk * bk) // bq), 0)
-    else:
-        def _q_map_dkv(i, kk, j):
-            return (i, j, 0)
+    _q_map_dkv = _frontier_q_map(bq, bk, causal)
 
     row_spec = pl.BlockSpec((None, bq, 1), lambda i, j, kk: (i, j, 0))
     dq = pl.pallas_call(
@@ -560,6 +714,7 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
         interpret = not on_tpu
     s = q.shape[1]
     blk = kernel_block(s)
+    bwq, bwk = _bwd_tiles(s, blk)
     if stash is not None and s % 128 == 0:
         from ..model.blocks import stash_collecting, stash_pop, stash_push
         if stash_collecting(stash):
@@ -573,9 +728,9 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
             return out
         out_s, lse_s = stash_pop(stash)
         return flash_precomputed(q, k, v, out_s, lse_s, scale, causal,
-                                 blk, blk, interpret)
+                                 bwq, bwk, interpret)
     if not on_tpu or s % 128 != 0:
         return _xla_reference(q, k, v, scale, causal)
     return flash_attention(q, k, v, scale, causal, blk,
                            kernel_block(s, cap=2048), interpret,
-                           bwd_block_q=blk, bwd_block_k=blk)
+                           bwd_block_q=bwq, bwd_block_k=bwk)

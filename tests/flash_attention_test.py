@@ -124,3 +124,90 @@ def bwd_block_override_parity_test():
                                    rtol=2e-4, atol=2e-5)
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("bq,bk", [(16, 16), (16, 32), (32, 16)])
+def fused_bwd_matches_split_test(causal, bq, bk, monkeypatch):
+    """The one-pass fused backward kernel (default) against the split
+    dq / dk/dv kernels and dense autodiff, across uneven tiles (the
+    diagonal frontier crossing block boundaries both ways) and both
+    causal modes."""
+    rng = np.random.default_rng(11)
+    b, s, h, d = 1, 96, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+
+    def grads():
+        return jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, 0.35, causal, bq, bk, True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+
+    g_fused = grads()
+    monkeypatch.setenv("HBNLP_FLASH_BWD_SPLIT", "1")
+    jax.clear_caches()
+    g_split = grads()
+    monkeypatch.delenv("HBNLP_FLASH_BWD_SPLIT")
+    jax.clear_caches()
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_reference(q, k, v, 0.35, causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b_, c in zip(g_fused, g_split, g_ref):
+        # fused vs split: same dots/rounding points, only the dq partial-sum
+        # order differs (VMEM sequential vs XLA reduce over nk)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def fused_bwd_uneven_lengths_test():
+    """_bwd_flat with sq != sk (the ring-hop contract allows it): fused vs
+    split parity on a rectangular non-causal pair."""
+    from homebrewnlp_tpu.parallel.flash_attention import _bwd_flat
+    rng = np.random.default_rng(12)
+    bh, sq, sk, d = 2, 32, 64, 8
+    f32 = np.float32
+    qt = jnp.asarray(rng.standard_normal((bh, sq, d)).astype(f32))
+    kt = jnp.asarray(rng.standard_normal((bh, sk, d)).astype(f32))
+    vt = jnp.asarray(rng.standard_normal((bh, sk, d)).astype(f32))
+    dot = jnp.asarray(rng.standard_normal((bh, sq, d)).astype(f32))
+    # consistent (lse, delta) residuals from the dense form
+    scores = jnp.einsum("zqd,zkd->zqk", qt, kt) * 0.35
+    m = scores.max(-1)
+    p_un = jnp.exp(scores - m[..., None])
+    l = p_un.sum(-1)
+    lse = m + jnp.log(l)
+    out = jnp.einsum("zqk,zkd->zqd", p_un / l[..., None], vt)
+    delta = jnp.sum(dot * out, -1, keepdims=True)
+
+    import os
+    res_fused = _bwd_flat(qt, kt, vt, dot, lse[..., None], delta, 0.35,
+                          False, 16, 16, True)
+    os.environ["HBNLP_FLASH_BWD_SPLIT"] = "1"
+    try:
+        jax.clear_caches()
+        res_split = _bwd_flat(qt, kt, vt, dot, lse[..., None], delta, 0.35,
+                              False, 16, 16, True)
+    finally:
+        del os.environ["HBNLP_FLASH_BWD_SPLIT"]
+    jax.clear_caches()
+    for a, b_ in zip(res_fused, res_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def bwd_tile_env_rounding_test(monkeypatch):
+    """HBNLP_BWD_BQ/BK retuning overrides round to power-of-two divisors of
+    the sequence (non-divisor junk must never reach the kernels — the grids
+    and _causal_split assume block-aligned tiles) with a floor of 128."""
+    from homebrewnlp_tpu.parallel.flash_attention import _bwd_tiles
+    assert _bwd_tiles(16384, 1024) == (1024, 1024)
+    monkeypatch.setenv("HBNLP_BWD_BQ", "2048")
+    assert _bwd_tiles(16384, 1024) == (2048, 1024)
+    monkeypatch.setenv("HBNLP_BWD_BQ", "1536")   # non-power-of-two junk
+    assert _bwd_tiles(16384, 1024) == (1024, 1024)
+    monkeypatch.setenv("HBNLP_BWD_BQ", "7")      # degenerate: floored to 128
+    assert _bwd_tiles(16384, 1024) == (128, 1024)
+    monkeypatch.setenv("HBNLP_BWD_BK", "512")
+    assert _bwd_tiles(16384, 1024)[1] == 512
