@@ -403,6 +403,72 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dqp_ref,
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_group_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                            dqp_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc,
+                            *, block_q: int, block_k: int, group: int,
+                            num_q: int, scale: float, causal: bool):
+    """Group-of-k fused backward: grid (b*h, k GROUPS, q blocks), q
+    innermost, each grid step sweeping ``group`` k blocks in an in-body
+    loop against one resident [group*bk, d] K/V tile.
+
+    Purpose: shrink the dq partial buffer.  The flat fused kernel writes
+    one dq partial per k BLOCK ([bh, nk, sq, d] f32 — ~1 GB per layer at
+    16k, ~45 ms/step of write+reduce HBM traffic); here dq accumulates in
+    VMEM scratch across the in-group loop and flushes one partial per k
+    GROUP, dividing that traffic by ``group``.  dk/dv accumulate across
+    the q sweep in a group-sized scratch, exactly as the flat kernel does
+    per block.  Per-pair math is identical."""
+    from jax.experimental import pallas as pl
+
+    ko = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    for ki in range(group):
+        lo = ki * block_k
+        k_blk = k_ref[lo:lo + block_k, :]
+        v_blk = v_ref[lo:lo + block_k, :]
+
+        def _score(k_blk=k_blk):
+            return jax.lax.dot_general(
+                q_ref[...], k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+        def _accumulate(s, k_blk=k_blk, v_blk=v_blk, lo=lo):
+            p = jnp.exp(s - lse_ref[...])
+            dp = jax.lax.dot_general(do_ref[...], v_blk,
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - d_ref[...]) * scale).astype(q_ref.dtype)
+            dq_acc[...] += jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_acc[lo:lo + block_k, :] += jax.lax.dot_general(
+                ds, q_ref[...], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dv_acc[lo:lo + block_k, :] += jax.lax.dot_general(
+                p.astype(do_ref.dtype), do_ref[...], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        # per-pair causal dispatch at the BLOCK index kk = ko*group + ki
+        # (the group's k_ref tile spans blocks [ko*group, ko*group+group))
+        _masked_step(qi, ko * group + ki, block_q, block_k, causal,
+                     _score, _accumulate)
+
+    dqp_ref[...] = dq_acc[...].astype(dqp_ref.dtype)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
 # dq-partial buffer cap for the fused backward (bytes); above it the split
 # kernels run instead (the buffer is nk x the dq size — negligible for ring
 # hop chunks, ~1GB at the 16k single-chip shape, and quadratic beyond)
@@ -413,7 +479,32 @@ def _use_fused_bwd(bh: int, s: int, sk: int, d: int, bk: int) -> bool:
     import os
     if os.environ.get("HBNLP_FLASH_BWD_SPLIT"):
         return False
-    return bh * (sk // bk) * s * d * 4 <= _FUSED_DQP_CAP
+    # gate on the GROUPED partial-buffer size so HBNLP_FUSED_GROUP routes
+    # to the group kernel (not silently to the split kernels) at exactly
+    # the large shapes where shrinking the buffer matters
+    nko = max(1, (sk // bk) // _fused_group(sk // bk))
+    return bh * nko * s * d * 4 <= _FUSED_DQP_CAP
+
+
+def _fused_group(nk: int) -> int:
+    """k blocks per grid step for the GROUP kernel — default 1 (flat fused
+    kernel), i.e. the group variant is OFF.
+
+    Measured dead end, kept for the record (``HBNLP_FUSED_GROUP=N`` to
+    re-measure; clamped to a divisor of nk): grouping k blocks shrinks the
+    dq partial buffer by N (~45 ms/step of write+reduce HBM traffic at the
+    16k shape) but the longer kernel body loses more than that to pipeline
+    stalls — v5e, 16k recipe, 64M vmem budget: flat 48-49k tok/s,
+    group 2 45.8k, group 4 35.7k.  Same economics as the norm-backward
+    pallas kernel (docs/PERFORMANCE.md round 3): the pipeline overlaps DMA
+    with compute ACROSS grid steps, and a grid step that serializes N pair
+    computations against one resident K/V tile starves that overlap."""
+    import os
+    want = int(os.environ.get("HBNLP_FUSED_GROUP", 0)) or 1
+    want = min(want, nk)
+    while want > 1 and nk % want:
+        want -= 1
+    return max(1, want)
 
 
 def _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
@@ -430,6 +521,44 @@ def _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
     dq_dtype = qt.dtype if out_dtype is None else out_dtype
     dk_dtype = kt.dtype if out_dtype is None else out_dtype
     dv_dtype = vt.dtype if out_dtype is None else out_dtype
+
+    group = _fused_group(nk)
+    if group > 1:
+        nko = nk // group
+        gbk = group * bk
+        _q_map = _frontier_q_map(bq, gbk, causal)
+        qrow_spec = pl.BlockSpec((None, bq, 1), _q_map)
+        dqp, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_group_kernel, block_q=bq,
+                              block_k=bk, group=group, num_q=nq, scale=scale,
+                              causal=causal),
+            grid=(bh, nko, nq),
+            in_specs=[pl.BlockSpec((None, bq, d), _q_map),
+                      pl.BlockSpec((None, gbk, d), lambda i, ko, j: (i, ko, 0)),
+                      pl.BlockSpec((None, gbk, d), lambda i, ko, j: (i, ko, 0)),
+                      pl.BlockSpec((None, bq, d), _q_map),
+                      qrow_spec, qrow_spec],
+            out_specs=[pl.BlockSpec((None, None, bq, d),
+                                    lambda i, ko, j: (i, ko, j, 0)),
+                       pl.BlockSpec((None, gbk, d), lambda i, ko, j: (i, ko, 0)),
+                       pl.BlockSpec((None, gbk, d), lambda i, ko, j: (i, ko, 0))],
+            out_shape=[jax.ShapeDtypeStruct((bh, nko, s, d), jnp.float32),
+                       jax.ShapeDtypeStruct((bh, sk, d), dk_dtype),
+                       jax.ShapeDtypeStruct((bh, sk, d), dv_dtype)],
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                            pltpu.VMEM((gbk, d), jnp.float32),
+                            pltpu.VMEM((gbk, d), jnp.float32)],
+            # the group-sized dk/dv scratch + pair temporaries exceed the
+            # 16M default scoped-vmem budget at (1024, 1024, G=2); v5e has
+            # 128M physical VMEM — raise the kernel's budget instead of
+            # shrinking tiles (measured faster than any fitting tile combo)
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+                vmem_limit_bytes=64 * 1024 * 1024),
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse3, delta)
+        dq = dqp.sum(axis=1).astype(dq_dtype)
+        return dq, dk, dv
 
     _q_map = _frontier_q_map(bq, bk, causal)
     qrow_spec = pl.BlockSpec((None, bq, 1), _q_map)
