@@ -471,8 +471,17 @@ def _bwd_fused_group_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
 
 # dq-partial buffer cap for the fused backward (bytes); above it the split
 # kernels run instead (the buffer is nk x the dq size — negligible for ring
-# hop chunks, ~1GB at the 16k single-chip shape, and quadratic beyond)
+# hop chunks, ~1GB at the 16k single-chip shape, and quadratic beyond).
+# HBNLP_FUSED_DQP_CAP_GB overrides (fractional OK): at 32k/batch-1 the
+# 4.3GB buffer fits the 16GB chip and the fused kernel still wins — but
+# that headroom is workload-dependent, so the default stays conservative
 _FUSED_DQP_CAP = 2 * 1024 ** 3
+
+
+def _fused_dqp_cap() -> int:
+    import os
+    gb = os.environ.get("HBNLP_FUSED_DQP_CAP_GB")
+    return int(float(gb) * 1024 ** 3) if gb else _FUSED_DQP_CAP
 
 
 def _use_fused_bwd(bh: int, s: int, sk: int, d: int, bk: int) -> bool:
@@ -483,7 +492,7 @@ def _use_fused_bwd(bh: int, s: int, sk: int, d: int, bk: int) -> bool:
     # to the group kernel (not silently to the split kernels) at exactly
     # the large shapes where shrinking the buffer matters
     nko = max(1, (sk // bk) // _fused_group(sk // bk))
-    return bh * nko * s * d * 4 <= _FUSED_DQP_CAP
+    return bh * nko * s * d * 4 <= _fused_dqp_cap()
 
 
 def _fused_group(nk: int) -> int:
