@@ -142,6 +142,10 @@ class ModelParameter:
         self.initial_autoregressive_position = 128
         self.use_autoregressive_sampling = False
         self.sampling_temperature = 0
+        # serving-side logits filters (beyond-reference: the reference
+        # always samples the full distribution); 0 / 1.0 = disabled
+        self.sampling_top_k = 0
+        self.sampling_top_p = 1.0
         self.weight_centralisation = True
         self.shuffle_input_filenames = True
         self.calc_accuracy = False

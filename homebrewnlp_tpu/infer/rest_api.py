@@ -33,7 +33,7 @@ def _complete_batch(interface: InterfaceWrapper,
     (InterfaceWrapper.complete_tokens_batch).  Per-item parse errors answer
     that item with an ``_error`` payload without failing the batch."""
     import numpy as np
-    prompts, temps, rls, idx = [], [], [], []
+    prompts, temps, rls, tks, tps, idx = [], [], [], [], [], []
     results: typing.List[typing.Optional[dict]] = [None] * len(items)
     for i, (path, body) in enumerate(items):
         try:
@@ -45,12 +45,16 @@ def _complete_batch(interface: InterfaceWrapper,
             prompts.append(toks)
             temps.append(float(body.get("temperature", 0.0)))
             rls.append(int(mt) if mt else None)
+            tk, tp = _parse_filters(body)
+            tks.append(tk)
+            tps.append(tp)
             idx.append(i)
         except Exception as e:
             results[i] = {"_error": str(e)}
     if idx:
         try:
-            outs = interface.complete_tokens_batch(prompts, temps, rls)
+            outs = interface.complete_tokens_batch(prompts, temps, rls,
+                                                   top_ks=tks, top_ps=tps)
             for j, i in enumerate(idx):
                 path, _ = items[i]
                 if path == "/completion":
@@ -67,13 +71,23 @@ def _complete_batch(interface: InterfaceWrapper,
 BATCHED_PATHS = ("/completion", "/token_completion")
 
 
+def _parse_filters(body: dict):
+    """Optional per-request logits filters: absent / 0 top_k and absent
+    top_p mean "use the config serving default" (None)."""
+    tk, tp = body.get("top_k"), body.get("top_p")
+    return (int(tk) if tk else None,
+            float(tp) if tp is not None else None)
+
+
 def _handlers(interface: InterfaceWrapper):
     def completion(body: dict) -> dict:
         prompt = body.get("prompt", "")
         temperature = float(body.get("temperature", 0.0))
         max_tokens = body.get("max_tokens")
+        tk, tp = _parse_filters(body)
         text = interface.complete(prompt, temperature,
-                                  int(max_tokens) if max_tokens else None)
+                                  int(max_tokens) if max_tokens else None,
+                                  top_k=tk, top_p=tp)
         return {"completion": text}
 
     def token_completion(body: dict) -> dict:
@@ -81,8 +95,10 @@ def _handlers(interface: InterfaceWrapper):
         tokens = np.asarray(body.get("tokens", []), np.int32)
         temperature = float(body.get("temperature", 0.0))
         max_tokens = body.get("max_tokens")
+        tk, tp = _parse_filters(body)
         out = interface.complete_tokens(tokens, temperature,
-                                        int(max_tokens) if max_tokens else None)
+                                        int(max_tokens) if max_tokens else None,
+                                        top_k=tk, top_p=tp)
         return {"tokens": [int(t) for t in out]}
 
     def encode(body: dict) -> dict:

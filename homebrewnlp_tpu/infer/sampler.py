@@ -21,7 +21,39 @@ from ..config import ModelParameter
 from ..model import Model
 
 
-def make_sampler(model: Model, mesh=None) -> typing.Callable:
+def _filter_logits(logits, tb, top_k, top_p):
+    """Top-k / nucleus (top-p) filtering, HuggingFace convention: the
+    distribution is softmax(logits / T) (our gumbel draw at scale T samples
+    exactly that), tokens outside the allowed set drop to -1e30.  Per-row
+    ``top_k`` int32 [batch] (<=0 disables) and ``top_p`` f32 [batch]
+    (>=1 disables); the argmax token is always kept, so greedy rows are
+    unaffected.  Beyond-reference serving surface — the reference samples
+    the full distribution only (src/run/inference.py:88-92)."""
+    v = logits.shape[-1]
+    bdim = (slice(None),) + (None,) * (logits.ndim - 2)
+    scaled = logits / jnp.maximum(tb, 1e-6)[bdim + (None,)]
+    srt = jnp.sort(scaled, axis=-1)[..., ::-1]           # descending
+    k_eff = jnp.where((top_k <= 0) | (top_k > v), v, top_k)[bdim + (None,)]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # sequential top-k THEN nucleus, both in sorted space: the nucleus mass
+    # renormalizes over the top-k survivors (HF TopK->TopP warper order),
+    # whose total mass is cum at position k_eff-1
+    mass_k = jnp.take_along_axis(cum, (k_eff - 1).astype(jnp.int32)
+                                 * jnp.ones_like(cum, jnp.int32)[..., :1],
+                                 axis=-1)
+    pos = jnp.arange(v)
+    keep_sorted = ((cum - probs) < top_p[bdim + (None,)] * mass_k) \
+        & (pos < k_eff)
+    # the crossing token is included and the set is never empty (top_p=0
+    # keeps exactly the argmax)
+    nkeep = jnp.maximum(keep_sorted.sum(-1, keepdims=True), 1)
+    pth = jnp.take_along_axis(srt, nkeep - 1, axis=-1)
+    return jnp.where(scaled >= pth, logits, -1e30)
+
+
+def make_sampler(model: Model, mesh=None,
+                 logits_filter: bool = False) -> typing.Callable:
     """Returns jit-able sample(variables, token_x, token_y, initial_pos,
     temperature, end_iterations, key) -> tokens [batch, seq, patch].
 
@@ -32,7 +64,7 @@ def make_sampler(model: Model, mesh=None) -> typing.Callable:
     params: ModelParameter = model.params
 
     def sample(variables, token_x, token_y, initial_pos, temperature,
-               end_iterations, key):
+               end_iterations, key, top_k=None, top_p=None):
         seq_axis = 1
         batch = token_x.shape[0]
         # per-row prompt lengths / temperatures (batched serving); scalars
@@ -40,6 +72,11 @@ def make_sampler(model: Model, mesh=None) -> typing.Callable:
         # row guard keeps longer prompts untouched until their own start
         ipb = jnp.broadcast_to(jnp.asarray(initial_pos, jnp.int32), (batch,))
         tb = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (batch,))
+        if logits_filter:
+            kb = jnp.broadcast_to(jnp.asarray(
+                0 if top_k is None else top_k, jnp.int32), (batch,))
+            pb = jnp.broadcast_to(jnp.asarray(
+                1.0 if top_p is None else top_p, jnp.float32), (batch,))
 
         def cond_fn(state):
             position, *_ = state
@@ -50,6 +87,8 @@ def make_sampler(model: Model, mesh=None) -> typing.Callable:
             info = model.apply(variables, {"token_x": token_x,
                                            "token_y": token_y}, mesh=mesh)
             logits = info.token_out.data.astype(jnp.float32)  # [b, s, tp, v]
+            if logits_filter:
+                logits = _filter_logits(logits, tb, kb, pb)
             key, sub = jax.random.split(key)
             u = jax.random.uniform(sub, logits.shape, jnp.float32,
                                    minval=1e-9, maxval=1.0)
@@ -149,8 +188,8 @@ def _match_cache_layout(model: Model, produced: dict, expected: dict) -> dict:
     return produced
 
 
-def make_kv_sampler(model: Model, mesh=None, prefill: bool = False
-                    ) -> typing.Callable:
+def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
+                    logits_filter: bool = False) -> typing.Callable:
     """KV-cached sampler: O(1) compute per token via ``Model.apply_decode``.
 
     Replaces the reference's full-model-per-token while_loop
@@ -181,13 +220,18 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False
     prefill's caches are the more faithful of the two.
     """
     def sample(variables, token_x, initial_pos, temperature, end_iterations,
-               key, caches=None):
+               key, caches=None, top_k=None, top_p=None):
         batch = token_x.shape[0]
         # per-row prompt lengths / temperatures (batched serving: each
         # concurrent request keeps its own boundary and noise scale);
         # scalars broadcast to the uniform single-request behaviour
         ipb = jnp.broadcast_to(jnp.asarray(initial_pos, jnp.int32), (batch,))
         tb = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (batch,))
+        if logits_filter:
+            kb = jnp.broadcast_to(jnp.asarray(
+                0 if top_k is None else top_k, jnp.int32), (batch,))
+            pb = jnp.broadcast_to(jnp.asarray(
+                1.0 if top_p is None else top_p, jnp.float32), (batch,))
         # iterations at position >= seq are no-ops in the full sampler (its
         # one-hot write misses); clamp instead of letting the update clamp
         end_iterations = jnp.minimum(end_iterations, token_x.shape[1])
@@ -230,6 +274,8 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False
             logits, caches = model.apply_decode(variables, cur, q, caches,
                                                 mesh=mesh)
             logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
+            if logits_filter:
+                logits = _filter_logits(logits, tb, kb, pb)
             key, sub = jax.random.split(key)
             u = jax.random.uniform(sub, logits.shape, jnp.float32,
                                    minval=1e-9, maxval=1.0)
@@ -256,19 +302,26 @@ def _jit_sampler(model: Model, mesh, kind: str):
     cache = model.__dict__.setdefault("_sampler_jit_cache", {})
     key = (mesh, kind)
     if key not in cache:
-        if kind == "kv":
-            fn = make_kv_sampler(model, mesh=mesh)
-        elif kind == "kv_prefill":
-            fn = make_kv_sampler(model, mesh=mesh, prefill=True)
+        # "+filter" kinds compile the top-k/top-p mask into the loop body;
+        # the plain kinds keep the exact unfiltered program (identical XLA
+        # to before the feature existed)
+        filt = kind.endswith("+filter")
+        base = kind[:-len("+filter")] if filt else kind
+        if base == "kv":
+            fn = make_kv_sampler(model, mesh=mesh, logits_filter=filt)
+        elif base == "kv_prefill":
+            fn = make_kv_sampler(model, mesh=mesh, prefill=True,
+                                 logits_filter=filt)
         else:
-            fn = make_sampler(model, mesh=mesh)
+            fn = make_sampler(model, mesh=mesh, logits_filter=filt)
         cache[key] = jax.jit(fn)
     return cache[key]
 
 
 def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
                 temperature=None, end_iterations=None, seed: int = 0,
-                use_cache: bool = True, pad_random: bool = False, mesh=None):
+                use_cache: bool = True, pad_random: bool = False, mesh=None,
+                top_k=None, top_p=None):
     """Convenience host-level entry (pads/crops the prompt to sequence
     length); prompt_tokens: int array [batch, <=seq] or [batch, seq, patch].
 
@@ -303,6 +356,16 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
         temperature = params.sampling_temperature
     if end_iterations is None:
         end_iterations = seq
+    if top_k is None:
+        top_k = params.sampling_top_k
+    if top_p is None:
+        top_p = params.sampling_top_p
+    # static routing: the filter kinds compile the top-k/top-p mask in;
+    # the default path's XLA program stays byte-identical to pre-feature
+    filt = (np.max(np.asarray(top_k)) > 0
+            or np.min(np.asarray(top_p)) < 1.0)
+    fargs = ((jnp.asarray(top_k, jnp.int32),
+              jnp.asarray(top_p, jnp.float32)) if filt else ())
     tokens_in = jnp.asarray(token_x)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -317,21 +380,21 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
             # calls to first generated token); initial_pos <= 1 has nothing
             # to prefill
             kind = "kv_prefill" if int(np.min(initial_pos)) > 1 else "kv"
-            fn = _jit_sampler(model, mesh, kind)
+            fn = _jit_sampler(model, mesh, kind + "+filter" if filt else kind)
             out = fn(variables, tokens_in,
                      jnp.asarray(initial_pos, jnp.int32),
                      jnp.asarray(temperature, jnp.float32),
                      jnp.asarray(end_iterations, jnp.int32),
-                     jax.random.PRNGKey(seed), None)
+                     jax.random.PRNGKey(seed), None, *fargs)
             return np.asarray(out)
         except NotImplementedError:
             pass  # layer without a streaming form: full-forward fallback
-    fn = _jit_sampler(model, mesh, "full")
+    fn = _jit_sampler(model, mesh, "full+filter" if filt else "full")
     out = fn(variables, tokens_in, tokens_in,
              jnp.asarray(initial_pos, jnp.int32),
              jnp.asarray(temperature, jnp.float32),
              jnp.asarray(end_iterations, jnp.int32),
-             jax.random.PRNGKey(seed))
+             jax.random.PRNGKey(seed), *fargs)
     return np.asarray(out)
 
 
