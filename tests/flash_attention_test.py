@@ -247,3 +247,26 @@ def fused_group_kernel_parity_test(monkeypatch):
                                    rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=2e-4, atol=2e-5)
+
+
+def flash_wide_head_dim_test():
+    """d=256 head dim through forward + fused backward (the shipped shapes
+    use d=128; the kernels must not silently assume it)."""
+    rng = np.random.default_rng(14)
+    b, s, h, d = 1, 64, 1, 256
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    out = flash_attention(q, k, v, d ** -0.5, True, 32, 32, True)
+    ref = _xla_reference(q, k, v, d ** -0.5, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g1 = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, d ** -0.5, True, 32, 32, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_reference(q, k, v, d ** -0.5, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
