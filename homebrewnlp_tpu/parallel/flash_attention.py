@@ -25,6 +25,12 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+# scoped-VMEM budget for the flash kernels: the compiler default (16M)
+# fits the d128-tuned tiles exactly; wider head dims scale the operand
+# blocks past it (d=256 forward: 16.64M).  v5e/v5p have 128M physical
+# VMEM - 64M leaves the pipeline slack while never tile-shrinking
+_KERNEL_VMEM_BUDGET = 64 * 1024 * 1024
+
 
 def _xla_reference(q, k, v, scale, causal):
     # XLA dead-code-eliminates the unused lse
@@ -251,9 +257,13 @@ def _fwd_flat(qt, kt, vt, scale, causal, block_q, block_k, interpret,
                         pltpu.VMEM((block_q, d), jnp.float32)],
         # the innermost k dimension carries the online-softmax scratch state
         # and MUST run sequentially ("arbitrary"); the outer two dims are
-        # independent and may be partitioned across megacore
+        # independent and may be partitioned across megacore.  vmem budget:
+        # the d128-tuned tiles overflow the compiler's 16M default by <1M at
+        # d=256 (the [blk, d] operand blocks scale with d); v5e has 128M
+        # physical VMEM, so raise the budget instead of shrinking tiles
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
         interpret=interpret,
     )(qt, kt, vt)
     return out, lse[..., 0]
@@ -590,7 +600,8 @@ def _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
     dq = dqp.sum(axis=1).astype(dq_dtype)
@@ -641,7 +652,8 @@ def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), dq_dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
 
@@ -662,7 +674,8 @@ def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
     return dq, dk, dv
