@@ -228,6 +228,22 @@ def main() -> int:
         print(json.dumps(out), flush=True)
     except Exception as exc:
         print(f"long-context companion bench failed: {exc}", file=sys.stderr)
+        return 0
+
+    # 32k companion (TPU only — the CPU fallback would shrink to the same
+    # shape as the 16k companion): the longest context one chip trains,
+    # fused backward admitted via the dq-partial cap override (BASELINE.md
+    # '32k context single-chip')
+    if jax.default_backend() != "cpu":
+        try:
+            os.environ.setdefault("HBNLP_FUSED_DQP_CAP_GB", "6")
+            lc32 = lc.run(seq=32768)
+            out["long_context_32k_tokens_per_sec_chip"] = lc32["value"]
+            if "mfu" in lc32:
+                out["long_context_32k_mfu"] = lc32["mfu"]
+            print(json.dumps(out), flush=True)
+        except Exception as exc:
+            print(f"32k companion bench failed: {exc}", file=sys.stderr)
     return 0
 
 
