@@ -146,6 +146,7 @@ class ModelParameter:
         # always samples the full distribution); 0 / 1.0 = disabled
         self.sampling_top_k = 0
         self.sampling_top_p = 1.0
+        self.sampling_repetition_penalty = 1.0
         self.weight_centralisation = True
         self.shuffle_input_filenames = True
         self.calc_accuracy = False

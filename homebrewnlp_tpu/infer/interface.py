@@ -86,7 +86,8 @@ class InterfaceWrapper:
     def complete_tokens(self, tokens: np.ndarray, temperature: float = 0.0,
                         response_len: typing.Optional[int] = None,
                         seed: int = 0, top_k: int = None,
-                        top_p: float = None) -> np.ndarray:
+                        top_p: float = None,
+                        repetition_penalty: float = None) -> np.ndarray:
         seq = self.params.sequence_length // self.params.token_patch_size
         prompt_len = min(len(tokens), seq - 1)
         end = seq if response_len is None else min(seq, prompt_len + response_len)
@@ -95,12 +96,13 @@ class InterfaceWrapper:
                           initial_pos=prompt_len, temperature=temperature,
                           end_iterations=end, seed=seed,
                           pad_random=True,  # reference interface.py:263
-                          mesh=self.mesh, top_k=top_k, top_p=top_p)
+                          mesh=self.mesh, top_k=top_k, top_p=top_p,
+                          repetition_penalty=repetition_penalty)
         return out[0, :end, 0] if out.ndim == 3 else out[0, :end]
 
     def complete_tokens_batch(self, token_lists, temperatures=None,
                               response_lens=None, seed: int = 0,
-                              top_ks=None, top_ps=None
+                              top_ks=None, top_ps=None, rep_penalties=None
                               ) -> typing.List[np.ndarray]:
         """N prompts -> one decode call (decode is cache-read-bandwidth
         bound: batch 8 is ~4x the aggregate throughput of batch 1,
@@ -133,6 +135,7 @@ class InterfaceWrapper:
         # seq - 1) and produce no output
         tks = np.full(width, p.sampling_top_k, np.int32)
         tps_arr = np.full(width, p.sampling_top_p, np.float32)
+        reps = np.full(width, p.sampling_repetition_penalty, np.float32)
         ends = []
         for i, toks in enumerate(token_lists):
             toks = np.asarray(toks).reshape(-1)[:seq - 1]
@@ -146,6 +149,8 @@ class InterfaceWrapper:
                 tks[i] = int(top_ks[i])
             if top_ps is not None and top_ps[i] is not None:
                 tps_arr[i] = float(top_ps[i])
+            if rep_penalties is not None and rep_penalties[i] is not None:
+                reps[i] = float(rep_penalties[i])
             rl = response_lens[i]
             ends.append(seq if rl is None else min(seq, len(toks) + int(rl)))
         self.decode_calls += 1
@@ -153,17 +158,20 @@ class InterfaceWrapper:
         out = sample_text(model_w, self.variables, token_x,
                           initial_pos=ip, temperature=temps,
                           end_iterations=max(ends), seed=seed,
-                          mesh=self.mesh, top_k=tks, top_p=tps_arr)
+                          mesh=self.mesh, top_k=tks, top_p=tps_arr,
+                          repetition_penalty=reps)
         if out.ndim == 3:
             out = out[:, :, 0]
         return [out[i, :ends[i]] for i in range(n)]
 
     def complete(self, query: str, temperature: float = 0.0,
                  response_len: typing.Optional[int] = None, seed: int = 0,
-                 top_k: int = None, top_p: float = None) -> str:
+                 top_k: int = None, top_p: float = None,
+                 repetition_penalty: float = None) -> str:
         tokens = self.tokenizer.encode(query)
         out = self.complete_tokens(tokens, temperature, response_len, seed,
-                                   top_k=top_k, top_p=top_p)
+                                   top_k=top_k, top_p=top_p,
+                                   repetition_penalty=repetition_penalty)
         return self.tokenizer.decode(out[len(tokens):])
 
 

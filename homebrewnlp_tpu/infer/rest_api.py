@@ -33,7 +33,7 @@ def _complete_batch(interface: InterfaceWrapper,
     (InterfaceWrapper.complete_tokens_batch).  Per-item parse errors answer
     that item with an ``_error`` payload without failing the batch."""
     import numpy as np
-    prompts, temps, rls, tks, tps, idx = [], [], [], [], [], []
+    prompts, temps, rls, tks, tps, rps, idx = [], [], [], [], [], [], []
     results: typing.List[typing.Optional[dict]] = [None] * len(items)
     for i, (path, body) in enumerate(items):
         try:
@@ -45,16 +45,18 @@ def _complete_batch(interface: InterfaceWrapper,
             prompts.append(toks)
             temps.append(float(body.get("temperature", 0.0)))
             rls.append(int(mt) if mt else None)
-            tk, tp = _parse_filters(body)
+            tk, tp, rp = _parse_filters(body)
             tks.append(tk)
             tps.append(tp)
+            rps.append(rp)
             idx.append(i)
         except Exception as e:
             results[i] = {"_error": str(e)}
     if idx:
         try:
             outs = interface.complete_tokens_batch(prompts, temps, rls,
-                                                   top_ks=tks, top_ps=tps)
+                                                   top_ks=tks, top_ps=tps,
+                                                   rep_penalties=rps)
             for j, i in enumerate(idx):
                 path, _ = items[i]
                 if path == "/completion":
@@ -73,10 +75,17 @@ BATCHED_PATHS = ("/completion", "/token_completion")
 
 def _parse_filters(body: dict):
     """Optional per-request logits filters: absent / 0 top_k and absent
-    top_p mean "use the config serving default" (None)."""
+    top_p / repetition_penalty mean "use the config serving default"
+    (None)."""
     tk, tp = body.get("top_k"), body.get("top_p")
+    rp = body.get("repetition_penalty")
+    if rp is not None and float(rp) <= 0:
+        # r <= 0 would turn seen tokens' logits into inf/NaN downstream —
+        # reject loudly (batched path answers the item with _error)
+        raise ValueError(f"repetition_penalty must be > 0, got {rp}")
     return (int(tk) if tk else None,
-            float(tp) if tp is not None else None)
+            float(tp) if tp is not None else None,
+            float(rp) if rp is not None else None)
 
 
 def _handlers(interface: InterfaceWrapper):
@@ -84,10 +93,10 @@ def _handlers(interface: InterfaceWrapper):
         prompt = body.get("prompt", "")
         temperature = float(body.get("temperature", 0.0))
         max_tokens = body.get("max_tokens")
-        tk, tp = _parse_filters(body)
+        tk, tp, rp = _parse_filters(body)
         text = interface.complete(prompt, temperature,
                                   int(max_tokens) if max_tokens else None,
-                                  top_k=tk, top_p=tp)
+                                  top_k=tk, top_p=tp, repetition_penalty=rp)
         return {"completion": text}
 
     def token_completion(body: dict) -> dict:
@@ -95,10 +104,11 @@ def _handlers(interface: InterfaceWrapper):
         tokens = np.asarray(body.get("tokens", []), np.int32)
         temperature = float(body.get("temperature", 0.0))
         max_tokens = body.get("max_tokens")
-        tk, tp = _parse_filters(body)
+        tk, tp, rp = _parse_filters(body)
         out = interface.complete_tokens(tokens, temperature,
                                         int(max_tokens) if max_tokens else None,
-                                        top_k=tk, top_p=tp)
+                                        top_k=tk, top_p=tp,
+                                        repetition_penalty=rp)
         return {"tokens": [int(t) for t in out]}
 
     def encode(body: dict) -> dict:

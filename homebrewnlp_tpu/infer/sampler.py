@@ -21,6 +21,18 @@ from ..config import ModelParameter
 from ..model import Model
 
 
+def _repetition_penalty(logits, seen, rep):
+    """HF-convention repetition penalty: tokens that already appeared
+    (``seen`` [batch, vocab] counts > 0) have positive logits divided by
+    ``rep`` and negative logits multiplied by it — both push the
+    probability down for rep > 1.  rep == 1 is identity."""
+    bdim = (slice(None),) + (None,) * (logits.ndim - 2)
+    r = rep[bdim + (None,)]
+    appeared = seen[:, None, None, :] > 0          # logits are [b, ., tp, v]
+    penalized = jnp.where(logits > 0, logits / r, logits * r)
+    return jnp.where(appeared, penalized, logits)
+
+
 def _filter_logits(logits, tb, top_k, top_p):
     """Top-k / nucleus (top-p) filtering, HuggingFace convention: the
     distribution is softmax(logits / T) (our gumbel draw at scale T samples
@@ -64,7 +76,7 @@ def make_sampler(model: Model, mesh=None,
     params: ModelParameter = model.params
 
     def sample(variables, token_x, token_y, initial_pos, temperature,
-               end_iterations, key, top_k=None, top_p=None):
+               end_iterations, key, top_k=None, top_p=None, rep_penalty=None):
         seq_axis = 1
         batch = token_x.shape[0]
         # per-row prompt lengths / temperatures (batched serving); scalars
@@ -77,6 +89,9 @@ def make_sampler(model: Model, mesh=None,
                 0 if top_k is None else top_k, jnp.int32), (batch,))
             pb = jnp.broadcast_to(jnp.asarray(
                 1.0 if top_p is None else top_p, jnp.float32), (batch,))
+            rb = jnp.broadcast_to(jnp.asarray(
+                1.0 if rep_penalty is None else rep_penalty, jnp.float32),
+                (batch,))
 
         def cond_fn(state):
             position, *_ = state
@@ -88,6 +103,15 @@ def make_sampler(model: Model, mesh=None,
                                            "token_y": token_y}, mesh=mesh)
             logits = info.token_out.data.astype(jnp.float32)  # [b, s, tp, v]
             if logits_filter:
+                # repetition penalty over the context BEFORE the write
+                # position (prompt + tokens generated so far)
+                vocab = model.params.vocab_size
+                rows = jnp.arange(batch)[:, None, None]
+                cmask = (jnp.arange(token_x.shape[1])[None, :, None]
+                         < position).astype(jnp.float32)
+                seen = jnp.zeros((batch, vocab), jnp.float32
+                                 ).at[rows, token_x].add(cmask)
+                logits = _repetition_penalty(logits, seen, rb)
                 logits = _filter_logits(logits, tb, kb, pb)
             key, sub = jax.random.split(key)
             u = jax.random.uniform(sub, logits.shape, jnp.float32,
@@ -220,7 +244,7 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
     prefill's caches are the more faithful of the two.
     """
     def sample(variables, token_x, initial_pos, temperature, end_iterations,
-               key, caches=None, top_k=None, top_p=None):
+               key, caches=None, top_k=None, top_p=None, rep_penalty=None):
         batch = token_x.shape[0]
         # per-row prompt lengths / temperatures (batched serving: each
         # concurrent request keeps its own boundary and noise scale);
@@ -232,6 +256,9 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
                 0 if top_k is None else top_k, jnp.int32), (batch,))
             pb = jnp.broadcast_to(jnp.asarray(
                 1.0 if top_p is None else top_p, jnp.float32), (batch,))
+            rb = jnp.broadcast_to(jnp.asarray(
+                1.0 if rep_penalty is None else rep_penalty, jnp.float32),
+                (batch,))
         # iterations at position >= seq are no-ops in the full sampler (its
         # one-hot write misses); clamp instead of letting the update clamp
         end_iterations = jnp.minimum(end_iterations, token_x.shape[1])
@@ -240,6 +267,19 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
         zero_first = (ipb == 0)[:, None]
         token_x = token_x.at[:, 0].set(
             jnp.where(zero_first, jnp.zeros_like(token_x[:, 0]), token_x[:, 0]))
+        if logits_filter:
+            # token-occurrence counts for the repetition penalty, seeded
+            # from each row's prompt region and scatter-updated per step.
+            # ipb == 0 rows still hold one context token: index 0 — the
+            # zero_first write just above (which is why this runs AFTER it);
+            # the full sampler counts it via cmask index < position from
+            # position 1, so seed it here too
+            vocab = model.params.vocab_size
+            rows = jnp.arange(batch)[:, None, None]
+            pmask = (jnp.arange(token_x.shape[1])[None, :, None]
+                     < jnp.maximum(ipb, 1)[:, None, None]).astype(jnp.float32)
+            seen0 = jnp.zeros((batch, vocab), jnp.float32
+                              ).at[rows, token_x].add(pmask)
 
         q_start = jnp.asarray(0, jnp.int32)
         if not caches:
@@ -269,12 +309,16 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
             return q < end_iterations - 1
 
         def body_fn(state):
-            q, token_x, caches, key = state
+            if logits_filter:
+                q, token_x, caches, key, seen = state
+            else:
+                q, token_x, caches, key = state
             cur = jax.lax.dynamic_slice_in_dim(token_x, q, 1, axis=1)
             logits, caches = model.apply_decode(variables, cur, q, caches,
                                                 mesh=mesh)
             logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
             if logits_filter:
+                logits = _repetition_penalty(logits, seen, rb)
                 logits = _filter_logits(logits, tb, kb, pb)
             key, sub = jax.random.split(key)
             u = jax.random.uniform(sub, logits.shape, jnp.float32,
@@ -285,10 +329,20 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
             new = jnp.where(q + 1 >= ipb[:, None, None], nxt, old)
             token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
                                                           axis=1)
+            if logits_filter:
+                # count the newly WRITTEN token (prompt rows not yet at
+                # their boundary keep `old`, already counted by seen0)
+                seen = seen.at[rows, new].add(
+                    (q + 1 >= ipb).astype(jnp.float32)[:, None, None])
+                return q + 1, token_x, caches, key, seen
             return q + 1, token_x, caches, key
 
-        _, token_x, _, _ = jax.lax.while_loop(
-            cond_fn, body_fn, (q_start, token_x, caches, key))
+        if logits_filter:
+            _, token_x, _, _, _ = jax.lax.while_loop(
+                cond_fn, body_fn, (q_start, token_x, caches, key, seen0))
+        else:
+            _, token_x, _, _ = jax.lax.while_loop(
+                cond_fn, body_fn, (q_start, token_x, caches, key))
         return token_x
 
     return sample
@@ -321,7 +375,7 @@ def _jit_sampler(model: Model, mesh, kind: str):
 def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
                 temperature=None, end_iterations=None, seed: int = 0,
                 use_cache: bool = True, pad_random: bool = False, mesh=None,
-                top_k=None, top_p=None):
+                top_k=None, top_p=None, repetition_penalty=None):
     """Convenience host-level entry (pads/crops the prompt to sequence
     length); prompt_tokens: int array [batch, <=seq] or [batch, seq, patch].
 
@@ -360,12 +414,17 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
         top_k = params.sampling_top_k
     if top_p is None:
         top_p = params.sampling_top_p
-    # static routing: the filter kinds compile the top-k/top-p mask in;
-    # the default path's XLA program stays byte-identical to pre-feature
+    if repetition_penalty is None:
+        repetition_penalty = params.sampling_repetition_penalty
+    # static routing: the filter kinds compile the top-k/top-p/repetition
+    # machinery in; the default path's XLA program stays byte-identical to
+    # pre-feature
     filt = (np.max(np.asarray(top_k)) > 0
-            or np.min(np.asarray(top_p)) < 1.0)
+            or np.min(np.asarray(top_p)) < 1.0
+            or bool(np.any(np.asarray(repetition_penalty) != 1.0)))
     fargs = ((jnp.asarray(top_k, jnp.int32),
-              jnp.asarray(top_p, jnp.float32)) if filt else ())
+              jnp.asarray(top_p, jnp.float32),
+              jnp.asarray(repetition_penalty, jnp.float32)) if filt else ())
     tokens_in = jnp.asarray(token_x)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
