@@ -57,6 +57,11 @@ def _config(tmp_path, data_dir, **overrides):
     return path
 
 
+def _cpu_env():
+    return dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                XLA_FLAGS="--xla_force_host_platform_device_count=1")
+
+
 def _run_cli(config_path, run_mode, timeout=420, input_text=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                XLA_FLAGS="--xla_force_host_platform_device_count=1")
@@ -282,3 +287,64 @@ def val_loss_e2e_test(tmp_path):
     # the eval set is fixed: two evals at the same params would agree, and
     # any recorded value must be a plausible xent for a 32-way vocab
     assert 0.0 < val_entries[0]["val/loss"] < 20.0
+
+
+def bpe_workflow_e2e_test(tmp_path):
+    """The full BPE user journey (reference: train_tokenizer.pyx ->
+    text2tfrecord.py BPE mode -> training): train a tokenizer with the
+    native C++ trainer, encode a corpus into int64 token records with
+    text2records --gpt2-bpe, and train a tiny model on them through
+    main.py — the token-id (vs byte) data path end to end."""
+    import glob
+    import json
+    import subprocess
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    corpus = tmp_path / "corpus.txt"
+    text = ("the quick brown fox jumps over the lazy dog. " * 200
+            + "pack my box with five dozen liquor jugs. " * 200)
+    corpus.write_text(text * 4)
+
+    tok_json = tmp_path / "tokenizer.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "train_tokenizer.py"),
+         str(corpus), "--vocab-size", "384", "--output", str(tok_json),
+         "--backend", "native", "--processes", "1"],
+        capture_output=True, text=True, timeout=300, env=_cpu_env())
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert tok_json.exists()
+
+    rec_dir = tmp_path / "records"
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "text2records.py"),
+         str(corpus), "--output-dir", str(rec_dir), "--prefix", "bpe",
+         "--gpt2-bpe", str(tok_json), "--chunk-tokens", "4096"],
+        capture_output=True, text=True, timeout=300, env=_cpu_env())
+    assert r.returncode == 0, r.stderr[-2000:]
+    files = glob.glob(str(rec_dir / "*.tfrecord"))
+    assert files and all("int64" in os.path.basename(f) for f in files), files
+
+    cfg = {
+        "model_mode": "gpt", "use_video": False, "use_language": True,
+        "sequence_length": 32, "features_per_head": 8, "heads": 2,
+        "depth": 2, "train_batch_size": 2, "vocab_size": 384,
+        "block_config": [{"layer": ["norm-shift-scale-features-group",
+                                    "feed_forward-in:relu"]}],
+        "memory_reduction_strategy": "none",
+        "optimizer": "adam-learning_rate", "learning_rate": 1e-3,
+        "train_steps": 8, "use_checkpointing": False,
+        "calculation_dtype": "float32", "storage_dtype": "float32",
+        "slice_dtype": "float32", "optimizer_slice_dtype": "float32",
+        "dataset_configs": [{"path": str(rec_dir / "*.tfrecord"),
+                             "weight": 1.0}],
+        "model_path": str(tmp_path / "run"),
+    }
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "main.py"),
+         "--model", str(cfg_path), "--run_mode", "train"],
+        capture_output=True, text=True, timeout=420, env=_cpu_env())
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "'final_step': 8" in r.stdout or '"final_step": 8' in r.stdout, \
+        r.stdout[-800:]

@@ -270,3 +270,31 @@ def flash_wide_head_dim_test():
     for a, b_ in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-4, atol=2e-5)
+
+
+def fused_bwd_random_shapes_property_test():
+    """Property sweep: random (seq, tiles, causal, dtype) combinations
+    through the fused backward vs dense autodiff — shape-dependent logic
+    (frontier clamps, dead-cell zero-fill, partial-slice counts, uneven
+    tile ratios) must hold everywhere, not just at the tuned points."""
+    rng = np.random.default_rng(99)
+    for trial in range(6):
+        s = int(rng.choice([48, 64, 80, 96, 128]))
+        divisors = [b for b in (8, 16, 32) if s % b == 0]
+        bq = int(rng.choice(divisors))
+        bk = int(rng.choice(divisors))
+        causal = bool(rng.integers(0, 2))
+        b, h, d = int(rng.integers(1, 3)), int(rng.integers(1, 3)), 8
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+        g1 = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, 0.3, causal, bq, bk, True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: jnp.sum(
+            _xla_reference(q, k, v, 0.3, causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-5,
+                err_msg=f"trial={trial} s={s} bq={bq} bk={bk} causal={causal}")
