@@ -141,6 +141,22 @@ def debug_mode(params: ModelParameter, args):
     debug_sample_check(interface)
 
 
+def analyze_mode(params: ModelParameter, args):
+    """Standalone model analysis: build (meshless, no device compute beyond
+    init) and print the parameter-count report without training — the
+    reference only ran analyze_model as a train-startup side effect
+    (src/run/utils_run.py:65-113); this exposes it as its own mode so a
+    config can be inspected before committing any compute to it."""
+    from .analysis import analyze_model
+    model = Model(params)
+    variables = model.init(_dummy_batch(params,
+                                        batch_size=params.train_batch_size))
+    # chief-only model_size.info write, like the train loop's call site
+    # (one shared model_path on multi-host pods)
+    analyze_model(params, variables, model.param_dims,
+                  dump=jax.process_index() == 0)
+
+
 RUN_MODE_FNS: typing.Dict[str, typing.Callable] = {
     "train": train_mode,
     "sample": sample_mode,
@@ -148,4 +164,5 @@ RUN_MODE_FNS: typing.Dict[str, typing.Callable] = {
     "query": query_mode,
     "web_api": web_api_mode,
     "debug": debug_mode,
+    "analyze": analyze_mode,   # new: config inspection without training
 }

@@ -23,7 +23,7 @@ def main():
                          "config only when given explicitly")
     ap.add_argument("--run_mode", type=str, default="train",
                     choices=["train", "sample", "query", "web_api", "debug",
-                             "debug_old"])
+                             "debug_old", "analyze"])
     ap.add_argument("--debug_grad", action="store_true")
     args = ap.parse_args()
 

@@ -348,3 +348,14 @@ def bpe_workflow_e2e_test(tmp_path):
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
     assert "'final_step': 8" in r.stdout or '"final_step": 8' in r.stdout, \
         r.stdout[-800:]
+
+
+def analyze_mode_test(tmp_path):
+    """--run_mode analyze: parameter-count report without training (the
+    reference only ran analyze_model as a train-startup side effect)."""
+    cfg = _config(tmp_path, _make_dataset(tmp_path))
+    r = _run_cli(cfg, "analyze", timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "total parameters:" in r.stdout, r.stdout[-500:]
+    assert os.path.exists(os.path.join(str(tmp_path), "run",
+                                       "model_size.info")), "report not dumped"
