@@ -34,7 +34,7 @@ def main() -> int:
     assert len(jax.devices()) == 4 * nproc, \
         f"expected {4 * nproc} global devices, got {len(jax.devices())}"
 
-    global_batch = 8
+    global_batch = 4 * nproc   # divisible by the data axis at any fan-out
     cfg = {"model_mode": "gpt", "use_video": False, "use_language": True,
            "sequence_length": 16, "features_per_head": 8, "heads": 2,
            "depth": 1, "train_batch_size": global_batch, "vocab_size": 256,

@@ -60,6 +60,17 @@ def two_process_assembly_test():
         assert f"worker {pid}: OK" in out, out
 
 
+def four_process_assembly_test():
+    """4 controllers x 4 virtual devices = a 16-device pod: the per-process
+    slice layout and cross-process gather must hold beyond the 2-process
+    case (process-group derivation at wider DCN fan-out)."""
+    results = _spawn_workers(os.path.join(HERE, "_multihost_worker.py"), [],
+                             n_procs=4, timeout=300)
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"worker {pid}: OK" in out, out
+
+
 def single_process_macro_axis_test():
     """shard_batch shards the batch axis (axis 1 under macro-batching), never
     the macro axis."""
