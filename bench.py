@@ -157,16 +157,21 @@ def main() -> int:
         val_loss = None
 
     # MFU: exact matmul FLOPs from the jaxpr, 3x-forward convention (no
-    # rematerialization credit — revnet's recompute is not "useful" FLOPs)
+    # rematerialization credit — revnet's recompute is not "useful" FLOPs).
+    # Dual convention: "mfu" counts causally-dead flash cells as useful
+    # (full-square, stable round-over-round); "mfu_causal" excludes them
+    # (the executed-FLOP denominator; emitted when the model has causal
+    # flash kernels)
     try:
-        from homebrewnlp_tpu.utils.flops import forward_flops, mfu
-        fwd_flops = forward_flops(
+        from homebrewnlp_tpu.utils.flops import forward_flops_split, mfu
+        fwd_flops, fwd_exec = forward_flops_split(
             lambda v, b: trainer.model.apply(v, b).total_loss.data,
             state.variables, batches[0])
         mfu_frac = mfu(fwd_flops, dt / MEASURE_STEPS, n_chips)
+        mfu_causal = mfu(fwd_exec, dt / MEASURE_STEPS, n_chips)
     except Exception as exc:
         print(f"MFU computation failed: {exc}", file=sys.stderr)
-        mfu_frac = None
+        mfu_frac = mfu_causal = None
 
     # first recorded value per backend becomes the baseline; later runs
     # report progress against it (batch size is part of the config identity
@@ -204,6 +209,8 @@ def main() -> int:
                            "MTF comparison hardware-blocked"}
     if mfu_frac is not None:
         out["mfu"] = round(mfu_frac, 4)
+    if mfu_causal is not None and round(mfu_causal, 4) != round(mfu_frac, 4):
+        out["mfu_causal"] = round(mfu_causal, 4)
     if val_loss is not None:
         out["val_loss"] = round(val_loss, 4)
     # the headline line goes out NOW: the companion's 16k compile can kill
@@ -225,6 +232,8 @@ def main() -> int:
         out["long_context_metric"] = lc_out["metric"]
         if "mfu" in lc_out:
             out["long_context_mfu"] = lc_out["mfu"]
+        if "mfu_causal" in lc_out:
+            out["long_context_mfu_causal"] = lc_out["mfu_causal"]
         print(json.dumps(out), flush=True)
     except Exception as exc:
         print(f"long-context companion bench failed: {exc}", file=sys.stderr)
@@ -235,15 +244,27 @@ def main() -> int:
     # fused backward admitted via the dq-partial cap override (BASELINE.md
     # '32k context single-chip')
     if jax.default_backend() != "cpu":
+        cap_key = "HBNLP_FUSED_DQP_CAP_GB"
+        cap_prev = os.environ.get(cap_key)
         try:
-            os.environ.setdefault("HBNLP_FUSED_DQP_CAP_GB", "6")
+            os.environ.setdefault(cap_key, "6")
             lc32 = lc.run(seq=32768)
             out["long_context_32k_tokens_per_sec_chip"] = lc32["value"]
             if "mfu" in lc32:
                 out["long_context_32k_mfu"] = lc32["mfu"]
+            if "mfu_causal" in lc32:
+                out["long_context_32k_mfu_causal"] = lc32["mfu_causal"]
             print(json.dumps(out), flush=True)
         except Exception as exc:
             print(f"32k companion bench failed: {exc}", file=sys.stderr)
+        finally:
+            # restore the ambient env: code added below (or an in-process
+            # rerun of the 16k/flagship measurement) must not inherit the
+            # 32k companion's fused-kernel cap
+            if cap_prev is None:
+                os.environ.pop(cap_key, None)
+            else:
+                os.environ[cap_key] = cap_prev
     return 0
 
 

@@ -261,6 +261,16 @@ class ModelParameter:
 
         # ---- validation / derivation (reference :189-271)
         assert self.macro_batching > 0, "macro_batching must be >= 1"
+        # the serving-default repetition penalty reaches _repetition_penalty
+        # whenever a request omits a value (sample mode, REPL, batched
+        # rows); r <= 0 would inf/NaN seen tokens' logits — apply the same
+        # >0 check the REST boundary applies to explicit request values.
+        # top_k/top_p need no check: the sampler defines behavior for every
+        # value (top_k <= 0 disables; top_p = 0 keeps the argmax, >= 1
+        # disables — infer/sampler.py _filter_logits)
+        if self.sampling_repetition_penalty <= 0:
+            raise ValueError("sampling_repetition_penalty must be > 0, got "
+                             f"{self.sampling_repetition_penalty}")
         if isinstance(self.position_embedding, str):
             self.position_embedding = self.position_embedding.split('-')
         if isinstance(self.token_embedding, str):

@@ -74,16 +74,17 @@ BATCHED_PATHS = ("/completion", "/token_completion")
 
 
 def _parse_filters(body: dict):
-    """Optional per-request logits filters: absent / 0 top_k and absent
-    top_p / repetition_penalty mean "use the config serving default"
-    (None)."""
+    """Optional per-request logits filters: absent means "use the config
+    serving default" (None). An explicit top_k of 0 (or any value <= 0)
+    means "disable top-k for this request" — the sampler treats <= 0 as
+    off — so a client can override a server default of top_k > 0."""
     tk, tp = body.get("top_k"), body.get("top_p")
     rp = body.get("repetition_penalty")
     if rp is not None and float(rp) <= 0:
         # r <= 0 would turn seen tokens' logits into inf/NaN downstream —
         # reject loudly (batched path answers the item with _error)
         raise ValueError(f"repetition_penalty must be > 0, got {rp}")
-    return (int(tk) if tk else None,
+    return (int(tk) if tk is not None else None,
             float(tp) if tp is not None else None,
             float(rp) if rp is not None else None)
 

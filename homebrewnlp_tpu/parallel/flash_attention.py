@@ -264,6 +264,9 @@ def _fwd_flat(qt, kt, vt, scale, causal, block_q, block_k, interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
+        # "causal" in the name lets the FLOP counter subtract the skipped
+        # dead cells (utils/flops.py count_matmul_flops_split)
+        name="flash_fwd_causal" if causal else "flash_fwd",
         interpret=interpret,
     )(qt, kt, vt)
     return out, lse[..., 0]
@@ -573,7 +576,14 @@ def _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
             # shrinking tiles (measured faster than any fitting tile combo)
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
-                vmem_limit_bytes=64 * 1024 * 1024),
+                vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
+            # deliberately NOT named "*_causal": the split FLOP counter
+            # models dead cells at grid-tile granularity, but this kernel
+            # masks at bk-sub-block granularity inside its unrolled group
+            # loop (and the body's `group` identical cond pairs defeat the
+            # counter's dedup) — leaving the name unmarked keeps its
+            # executed count conservatively equal to full-square
+            name="flash_bwd_fused_group",
             interpret=interpret,
         )(qt, kt, vt, dot, lse3, delta)
         dq = dqp.sum(axis=1).astype(dq_dtype)
@@ -602,6 +612,7 @@ def _bwd_flat_fused(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
+        name="flash_bwd_fused_causal" if causal else "flash_bwd_fused",
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
     dq = dqp.sum(axis=1).astype(dq_dtype)
@@ -654,6 +665,7 @@ def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
+        name="flash_bwd_dq_causal" if causal else "flash_bwd_dq",
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
 
@@ -676,6 +688,7 @@ def _bwd_flat(qt, kt, vt, dot, lse3, delta, scale, causal, bq, bk,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_KERNEL_VMEM_BUDGET),
+        name="flash_bwd_dkv_causal" if causal else "flash_bwd_dkv",
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta)
     return dq, dk, dv

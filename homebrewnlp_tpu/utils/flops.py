@@ -65,8 +65,32 @@ def _conv_flops(eqn) -> int:
 
 
 def count_matmul_flops(jaxpr) -> int:
-    """Total dot/conv FLOPs in a (closed) jaxpr, scans scaled by length."""
+    """Total dot/conv FLOPs in a (closed) jaxpr, scans scaled by length.
+
+    Full-square convention: every pallas grid cell is counted as if live,
+    including the causally-dead cells the flash kernels skip.  Kept stable
+    round-over-round; use :func:`count_matmul_flops_split` for the
+    executed-FLOP (causal) count alongside it."""
+    return count_matmul_flops_split(jaxpr)[0]
+
+
+def count_matmul_flops_split(jaxpr) -> typing.Tuple[int, int]:
+    """(full, executed) dot/conv FLOPs of a (closed) jaxpr.
+
+    ``full`` is the stable full-square convention (see
+    :func:`count_matmul_flops`).  ``executed`` subtracts the causally-dead
+    grid cells of causal pallas kernels (the cells ``pl.when`` skips —
+    flash_attention.py names those calls ``*_causal``), i.e. the FLOPs the
+    hardware actually performs.  Dense masked attention (the XLA fallback)
+    executes the full square, so there ``executed == full``."""
+    total, dead = _count_split(jaxpr)
+    return total, total - dead
+
+
+def _count_split(jaxpr) -> typing.Tuple[int, int]:
+    """Recursive core: (full-square total, causally-dead) FLOPs."""
     total = 0
+    dead = 0
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if prim == "dot_general":
@@ -74,39 +98,62 @@ def count_matmul_flops(jaxpr) -> int:
         elif prim == "conv_general_dilated":
             total += _conv_flops(eqn)
         elif prim == "scan":
-            total += eqn.params["length"] * count_matmul_flops(
-                eqn.params["jaxpr"].jaxpr)
+            t, d = _count_split(eqn.params["jaxpr"].jaxpr)
+            total += eqn.params["length"] * t
+            dead += eqn.params["length"] * d
         elif prim == "while":
             # trip count unknown; count one body iteration
-            total += count_matmul_flops(eqn.params["body_jaxpr"].jaxpr)
+            t, d = _count_split(eqn.params["body_jaxpr"].jaxpr)
+            total += t
+            dead += d
         elif prim in ("custom_vjp_call", "custom_jvp_call",
                       "custom_vjp_call_jaxpr", "remat", "checkpoint"):
             inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
             if inner is not None:
-                total += count_matmul_flops(getattr(inner, "jaxpr", inner))
+                t, d = _count_split(getattr(inner, "jaxpr", inner))
+                total += t
+                dead += d
         elif prim in ("pjit", "jit", "xla_call", "closed_call", "core_call",
                       "shard_map"):
             inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
             if inner is not None:
-                total += count_matmul_flops(getattr(inner, "jaxpr", inner))
+                t, d = _count_split(getattr(inner, "jaxpr", inner))
+                total += t
+                dead += d
         elif prim == "cond":
             branches = eqn.params.get("branches", ())
             if branches:
-                total += max(count_matmul_flops(b.jaxpr) for b in branches)
+                t, d = max((_count_split(b.jaxpr)
+                            for b in branches), key=lambda td: td[0])
+                total += t
+                dead += d
         elif prim == "pallas_call":
             # kernel body runs once per grid cell (e.g. the flash-attention
             # QK^T/PV block matmuls); grid product x body FLOPs.  Every grid
-            # cell is counted as if live — the full-square convention for
-            # causal flash kernels, kept stable round-over-round
+            # cell is counted as if live in ``total`` — the full-square
+            # convention for causal flash kernels, kept stable
+            # round-over-round.  Causal kernels (name carries "causal";
+            # grid (batch·heads, a, b) with {a, b} = {q blocks, k blocks}
+            # in either order) additionally report their skipped cells in
+            # ``dead``: live block pairs are the ones overlapping the lower
+            # triangle, sum_j min(b, ceil(j·b/a)) — transpose-symmetric, so
+            # the (i, q, k) and (i, k, q) grids count identically
             inner = eqn.params.get("jaxpr")
             gm = eqn.params.get("grid_mapping")
             grid = getattr(gm, "grid", ()) if gm is not None else ()
             cells = int(np.prod([g for g in grid if isinstance(g, int)],
                                 dtype=np.int64)) if grid else 1
             if inner is not None:
-                total += cells * _pallas_body_flops(getattr(inner, "jaxpr",
-                                                            inner))
-    return total
+                body = _pallas_body_flops(getattr(inner, "jaxpr", inner))
+                total += cells * body
+                name = str(eqn.params.get("name", "") or "")
+                if "causal" in name and len(grid) == 3 \
+                        and all(isinstance(g, int) for g in grid):
+                    a, b = grid[1], grid[2]
+                    live = sum(min(b, (j * b + a - 1) // a)
+                               for j in range(1, a + 1))
+                    dead += grid[0] * (a * b - live) * body
+    return total, dead
 
 
 def _pallas_body_flops(jaxpr) -> int:
@@ -139,6 +186,14 @@ def forward_flops(fn, *args) -> int:
     """Matmul FLOPs of one forward call (traced abstractly, no execution)."""
     jaxpr = jax.make_jaxpr(fn)(*args)
     return count_matmul_flops(jaxpr.jaxpr)
+
+
+def forward_flops_split(fn, *args) -> typing.Tuple[int, int]:
+    """(full-square, executed) matmul FLOPs of one forward call — the
+    executed count excludes the causally-dead cells the flash kernels skip
+    (:func:`count_matmul_flops_split`)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return count_matmul_flops_split(jaxpr.jaxpr)
 
 
 def mfu(fwd_flops_per_step: float, step_time_s: float, n_chips: int = 1,

@@ -115,14 +115,18 @@ def run(bwd: str = "pallas", seq: int = 16384) -> dict:
     tok_s = tokens / dt / n_chips
 
     try:
-        from homebrewnlp_tpu.utils.flops import forward_flops, mfu
-        fwd = forward_flops(
+        from homebrewnlp_tpu.utils.flops import forward_flops_split, mfu
+        fwd, fwd_exec = forward_flops_split(
             lambda v, b: trainer.model.apply(v, b).total_loss.data,
             state.variables, batches[0])
+        # two conventions, one timing: full-square (dead causal cells count
+        # as useful — stable round-over-round) and causal/executed (dead
+        # cells excluded — the honest kernel-work denominator)
         mfu_frac = round(mfu(fwd, dt / MEASURE_STEPS, n_chips), 4)
+        mfu_causal = round(mfu(fwd_exec, dt / MEASURE_STEPS, n_chips), 4)
     except Exception as exc:
         print(f"MFU computation failed: {exc}", file=sys.stderr)
-        mfu_frac = None
+        mfu_frac = mfu_causal = None
 
     print(f"final loss {final_loss:.4f}", file=sys.stderr)
     out = {"metric": f"LM tokens/sec/chip @ {params.sequence_length}-ctx "
@@ -131,6 +135,8 @@ def run(bwd: str = "pallas", seq: int = 16384) -> dict:
            "flash_bwd": bwd}
     if mfu_frac is not None:
         out["mfu"] = mfu_frac
+    if mfu_causal is not None and mfu_causal != mfu_frac:
+        out["mfu_causal"] = mfu_causal
     return out
 
 

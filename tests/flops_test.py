@@ -27,3 +27,29 @@ def flops_counter_test():
         return out
     sq = jnp.zeros((16, 16))
     assert forward_flops(scanned, sq, sq) == 5 * 2 * 16 ** 3
+
+
+def flops_split_causal_flash_test():
+    """count_matmul_flops_split: full keeps the stable full-square
+    convention; executed subtracts the causally-dead pallas cells.  For a
+    causal grid of n x n blocks, live pairs = n(n+1)/2, so executed/full of
+    the kernel's own FLOPs is (n+1)/(2n)."""
+    from homebrewnlp_tpu.parallel.flash_attention import flash_attention
+    from homebrewnlp_tpu.utils.flops import forward_flops_split
+
+    b, s, h, d, blk = 1, 64, 1, 16, 16  # 4 x 4 block grid
+    q = jnp.zeros((b, s, h, d))
+
+    def fwd(causal):
+        return lambda x: flash_attention(x, x, x, 1.0, causal, blk, blk, True)
+
+    full_c, exec_c = forward_flops_split(fwd(True), q)
+    full_nc, exec_nc = forward_flops_split(fwd(False), q)
+    # non-causal: nothing skipped
+    assert full_nc == exec_nc
+    # same full-square count either way (stable convention)
+    assert full_c == full_nc
+    # causal executed: 10 of 16 cells live -> kernel FLOPs scale by 10/16
+    n = s // blk
+    live_frac = (n + 1) / (2 * n)
+    assert exec_c == int(full_c * live_frac)
