@@ -137,21 +137,37 @@ class Trainer:
             return (info.total_loss.data if idx is None
                     else info.loss_list[idx].data), info
 
+        # the strategy backwards (revnet/momentum custom_vjp) re-trace
+        # blocks AFTER model.apply's scope exited; without an active scope
+        # the replay would see mesh=None and route attention differently
+        # than the forward (flash instead of ring on a sequence-sharded
+        # mesh — under stash_attention_outputs the provide would then
+        # consume a ring-stashed (out, lse) pair through the flash path).
+        # custom_vjp bwd rules trace synchronously inside value_and_grad,
+        # so a thin mesh-bearing context keeps forward and replay routing
+        # identical
+        from ..core import scope as scope_mod
+        grad_ctx = scope_mod.Context("apply", mesh=self.mesh)
+
         if p.multi_loss_strategy in ("pcgrad", "mgda"):
             # per-loss backward passes, combined by gradient surgery
             infos = None
             grads_per_loss = []
             n_losses = 2 if (p.use_language and p.use_video) else 1
-            for i in range(n_losses):
-                (_, infos), g = jax.value_and_grad(
-                    functools.partial(loss_of, idx=i), has_aux=True)(variables)
-                grads_per_loss.append(g)
+            with scope_mod.context(grad_ctx):
+                for i in range(n_losses):
+                    (_, infos), g = jax.value_and_grad(
+                        functools.partial(loss_of, idx=i),
+                        has_aux=True)(variables)
+                    grads_per_loss.append(g)
             if n_losses > 1:
                 grads = MULTI_LOSS_GRADIENTS[p.multi_loss_strategy](grads_per_loss)
             else:
                 grads = grads_per_loss[0]
             return grads, infos
-        (_, info), grads = jax.value_and_grad(loss_of, has_aux=True)(variables)
+        with scope_mod.context(grad_ctx):
+            (_, info), grads = jax.value_and_grad(loss_of,
+                                                  has_aux=True)(variables)
         return grads, info
 
     def _micro_step(self, carry, batch_rng):
