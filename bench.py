@@ -240,14 +240,12 @@ def main() -> int:
         return 0
 
     # 32k companion (TPU only — the CPU fallback would shrink to the same
-    # shape as the 16k companion): the longest context one chip trains,
-    # fused backward admitted via the dq-partial cap override (BASELINE.md
-    # '32k context single-chip')
+    # shape as the 16k companion): the longest context one chip trains.
+    # The fused backward admits its 4.3GB dq-partial buffer through the
+    # memory-aware default cap (flash_attention._fused_dqp_cap) — no env
+    # override needed since round 5
     if jax.default_backend() != "cpu":
-        cap_key = "HBNLP_FUSED_DQP_CAP_GB"
-        cap_prev = os.environ.get(cap_key)
         try:
-            os.environ.setdefault(cap_key, "6")
             lc32 = lc.run(seq=32768)
             out["long_context_32k_tokens_per_sec_chip"] = lc32["value"]
             if "mfu" in lc32:
@@ -257,14 +255,6 @@ def main() -> int:
             print(json.dumps(out), flush=True)
         except Exception as exc:
             print(f"32k companion bench failed: {exc}", file=sys.stderr)
-        finally:
-            # restore the ambient env: code added below (or an in-process
-            # rerun of the 16k/flagship measurement) must not inherit the
-            # 32k companion's fused-kernel cap
-            if cap_prev is None:
-                os.environ.pop(cap_key, None)
-            else:
-                os.environ[cap_key] = cap_prev
     return 0
 
 

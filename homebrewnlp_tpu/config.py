@@ -229,7 +229,11 @@ class ModelParameter:
         # flash path AND the sequence-parallel zigzag ring (whose
         # strategy-backward recompute otherwise re-runs the whole ring,
         # P hops of kernels and ppermutes, per layer).
-        self.stash_attention_outputs = False
+        # True/False, or "auto" (default): enable attention-output stashing
+        # when the sequence is long enough to pay and the stash fits a small
+        # HBM fraction (model/blocks.py resolve_stash) — the measured 16k/32k
+        # recipes then need no explicit flag
+        self.stash_attention_outputs = "auto"
         # lax.scan unroll factor for the depth scan (XLA overlap vs memory)
         self.scan_unroll = 1
         self.gradient_checkpointing_policy = "nothing_saveable"
@@ -271,6 +275,12 @@ class ModelParameter:
         if self.sampling_repetition_penalty <= 0:
             raise ValueError("sampling_repetition_penalty must be > 0, got "
                              f"{self.sampling_repetition_penalty}")
+        # tri-state: any other string would fall through bool("...") == True
+        # and silently force-enable stashing ("false" enabling a feature)
+        if self.stash_attention_outputs not in (True, False, "auto"):
+            raise ValueError("stash_attention_outputs must be true, false, "
+                             f"or \"auto\", got "
+                             f"{self.stash_attention_outputs!r}")
         if isinstance(self.position_embedding, str):
             self.position_embedding = self.position_embedding.split('-')
         if isinstance(self.token_embedding, str):

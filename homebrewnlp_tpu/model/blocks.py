@@ -479,13 +479,51 @@ def _scan_prologue(params: ModelParameter, ctx, plan, src: NamedTensor,
     return stacked, shared, tuple(fns)
 
 
+def resolve_stash(params: ModelParameter, mesh=None) -> bool:
+    """``stash_attention_outputs``: True/False pass through; ``"auto"``
+    (the default) enables stashing when it measurably pays AND fits.
+
+    Stashing trades HBM residents (each attention layer's (out, lse) rides
+    the strategy custom_vjp residuals) for skipping the flash forward
+    kernel in the revnet/momentum backward recompute — +23% at 16k ctx
+    (docs/PERFORMANCE.md).  Worth it only when the attention forward is
+    expensive (long sequences; the kernels engage at seq % 128 == 0
+    anyway) and the PER-DEVICE stash is a small fraction of HBM: the
+    (out [b,s,h,d], lse [b,h,s]) arrays shard over every data/model/
+    sequence mesh axis, so the global estimate divides by the mesh size,
+    and the HBM figure comes from the mesh's own devices (an AOT lowering
+    for a pod budgets against the pod's chips, not the local client).
+    Sized conservatively as if every block held one attention layer."""
+    v = getattr(params, "stash_attention_outputs", False)
+    if v != "auto":
+        return bool(v)
+    seq = params.sequence_length // max(1, params.token_patch_size)
+    if seq < 2048 or seq % 128:
+        return False
+    from ..utils.flops import device_hbm_bytes
+    import numpy as np
+    calc_bytes = np.dtype(params.calculation_dtype).itemsize
+    per_layer = (params.train_batch_size * seq * params.heads
+                 * params.features_per_head * calc_bytes
+                 + params.train_batch_size * params.heads * seq * 4)
+    total = per_layer * params.depth * max(1, params.macro_batching)
+    device = None
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        shards = 1
+        for axis in ("data", "model", "sequence"):
+            shards *= mesh.shape.get(axis, 1)
+        total = -(-total // shards)
+        device = np.asarray(mesh.devices).flat[0]
+    return total <= 0.15 * device_hbm_bytes(device)
+
+
 def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
               strategy: str, attn_base: int) -> typing.Optional[NamedTensor]:
     pro = _scan_prologue(params, ctx, plan, src, attn_base)
     if pro is None:
         return None
     stacked, shared, fns = pro
-    stash = bool(getattr(params, "stash_attention_outputs", False))
+    stash = resolve_stash(params, ctx.mesh)
     if strategy == "revnet":
         x1, x2 = rev_scan(fns, params.scan_unroll, stacked, shared, src, src,
                           stash)
@@ -828,7 +866,7 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         if scanned is not None:
             return scanned, plan
 
-    stash = bool(getattr(params, "stash_attention_outputs", False))
+    stash = resolve_stash(params, ctx.mesh)
     if strategy == "revnet":
         x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src, stash)
         return x1 + x2, plan

@@ -29,6 +29,43 @@ PEAK_TFLOPS = {
 }
 
 
+# HBM bytes per chip by device kind; the axon tunnel returns no
+# memory_stats, so capacity planning (stash auto-enable, fused-backward
+# dq-partial cap) keys on the kind string
+HBM_BYTES = {
+    "TPU v5 lite": int(15.75 * 1024 ** 3),   # v5e
+    "TPU v5e": int(15.75 * 1024 ** 3),
+    "TPU v5": 95 * 1024 ** 3,                # v5p
+    "TPU v5p": 95 * 1024 ** 3,
+    "TPU v4": 32 * 1024 ** 3,
+    "TPU v4 lite": 8 * 1024 ** 3,
+    "TPU v6 lite": 32 * 1024 ** 3,           # v6e / Trillium
+    "TPU v6e": 32 * 1024 ** 3,
+    "cpu": 16 * 1024 ** 3,                   # nominal planning figure
+}
+
+
+def device_hbm_bytes(device: typing.Optional[jax.Device] = None) -> int:
+    """Per-chip HBM for capacity planning (device kind table; the runtime's
+    memory_stats is unavailable through the tunnel)."""
+    if device is None:
+        device = jax.devices()[0]
+    stats = None
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        pass
+    if stats and stats.get("bytes_limit"):
+        return int(stats["bytes_limit"])
+    kind = getattr(device, "device_kind", "cpu")
+    if kind in HBM_BYTES:
+        return HBM_BYTES[kind]
+    for name, cap in HBM_BYTES.items():
+        if name.lower() in str(kind).lower():
+            return cap
+    return HBM_BYTES["cpu"]
+
+
 def peak_flops(device: typing.Optional[jax.Device] = None) -> float:
     if device is None:
         device = jax.devices()[0]

@@ -40,10 +40,10 @@ LC_CONFIG = {
     "calculation_dtype": "bfloat16", "storage_dtype": "bfloat16",
     "optimizer_slice_dtype": "float32", "slice_dtype": "float32",
     "scan_layers": True, "use_flash_attention": True,
-    # stash (out, lse) per attention layer so the revnet backward's
-    # recompute skips the forward kernel (~520MB extra residents at these
-    # shapes; attention dominates, so it pays — see docs/PERFORMANCE.md)
-    "stash_attention_outputs": True,
+    # stash_attention_outputs intentionally NOT set: the "auto" default
+    # must enable it here itself (~545MB of (out, lse) residents at 16k —
+    # model/blocks.py resolve_stash) — this bench is the standing proof
+    # that the shipped defaults reproduce the measured numbers
     "use_checkpointing": False, "macro_batching": 1,
     "model_path": "/tmp/bench_long_context",
 }

@@ -160,6 +160,18 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
     model = Model(params)
     trainer = Trainer(params, model, mesh)
 
+    # memory-aware kernel/stash heuristics must budget against the TARGET
+    # chips, not the local client (a CPU/tunnel process lowering for a v5p
+    # pod would otherwise bake a 16GiB-derived dq-partial cap into a 95GiB
+    # chip's executable).  resolve_stash reads the mesh's own devices; the
+    # fused-backward cap has no device argument, so pin it via its env
+    # override for the lowering
+    from homebrewnlp_tpu.utils.flops import device_hbm_bytes
+    target_hbm = device_hbm_bytes(devices[0])
+    cap_key = "HBNLP_FUSED_DQP_CAP_GB"
+    cap_prev = os.environ.get(cap_key)
+    os.environ[cap_key] = str(0.30 * target_hbm / 1024 ** 3)
+
     seq = params.sequence_length // params.token_patch_size
     batch_np = {
         "token_x": np.zeros((params.train_batch_size, seq,
@@ -202,10 +214,16 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
 
     step_fn = trainer._build_step()
     t_trace = time.time()
-    lowered = step_fn.lower(state_avals, batch_avals, rng_aval)
-    t_lower = time.time()
-    compiled = lowered.compile()
-    t_compile = time.time()
+    try:
+        lowered = step_fn.lower(state_avals, batch_avals, rng_aval)
+        t_lower = time.time()
+        compiled = lowered.compile()
+        t_compile = time.time()
+    finally:
+        if cap_prev is None:
+            os.environ.pop(cap_key, None)
+        else:
+            os.environ[cap_key] = cap_prev
 
     ma = compiled.memory_analysis()
     hlo = compiled.as_text()

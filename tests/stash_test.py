@@ -94,6 +94,50 @@ def stash_non_flash_block_test():
                                    rtol=2e-4, atol=1e-5, err_msg=n)
 
 
+def stash_auto_resolution_test():
+    """The "auto" default (round 5): off below 2048 ctx and off for
+    non-128-multiple sequences, on for the long-context shapes whose stash
+    fits the HBM fraction, off when the stash would be too large; explicit
+    booleans pass through untouched; other strings rejected at config
+    load."""
+    from homebrewnlp_tpu.model.blocks import resolve_stash
+
+    def p(**kw):
+        base = dict(features_per_head=128, heads=8, depth=16,
+                    train_batch_size=1, use_flash_attention=True)
+        base.update(kw)
+        return make_params(**base)
+
+    assert resolve_stash(p(sequence_length=16384,
+                           stash_attention_outputs="auto"))  # 16k recipe
+    assert not resolve_stash(p(sequence_length=512,
+                               stash_attention_outputs="auto"))  # short ctx
+    assert not resolve_stash(p(sequence_length=16384 + 64,
+                               stash_attention_outputs="auto"))  # gate %128
+    # far over the HBM fraction (batch 64 x 32k: ~70GB of stash on a 16GB
+    # planning figure)
+    assert not resolve_stash(p(sequence_length=32768, train_batch_size=64,
+                               stash_attention_outputs="auto"))
+    assert resolve_stash(p(sequence_length=512,
+                           stash_attention_outputs=True))  # explicit wins
+    assert not resolve_stash(p(sequence_length=16384,
+                               stash_attention_outputs=False))
+    # per-device sizing: a global batch that over-fills one chip still
+    # stashes when sharded 8 ways (the scaled-out 16k recipe keeps its win)
+    import jax
+    from homebrewnlp_tpu.core import sharding as shardlib
+    big = p(sequence_length=16384, train_batch_size=8,
+            stash_attention_outputs="auto",
+            mesh_shape_override={"data": 8})
+    if len(jax.devices()) >= 8:
+        mesh = shardlib.build_mesh(big)
+        assert not resolve_stash(big)          # global estimate: too big
+        assert resolve_stash(big, mesh)        # per-device: fits
+    # a non-boolean string is a config error, not a silent truthy enable
+    with pytest.raises(ValueError):
+        p(stash_attention_outputs="false")
+
+
 def ring_stash_parity_test():
     """Sequence-parallel (zigzag ring) stashing: the strategy backward's
     recompute skips the whole ring — P hops of compute AND ppermutes —
