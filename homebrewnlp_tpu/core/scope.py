@@ -176,4 +176,20 @@ def get_param(name_leaf: str, dims, initializer, slice_dtype, calc_dtype
         ctx.touched.append(name)
     data = ctx.params[name]
     assert tuple(data.shape) == sizes, (name, data.shape, sizes)
-    return nt(data.astype(calc_dtype), dims)
+    return nt(materialize_param(ctx, name, data, calc_dtype), dims)
+
+
+def materialize_param(ctx: Context, name: str, data, calc_dtype):
+    """Parameter value in calculation dtype; int8-quantized serving weights
+    (infer/quant.py) dequantize here — the convert+scale chain fuses into
+    the consuming dot's operand read, so the HBM traffic stays int8.
+
+    The dtype gate (not just name-in-scales) makes a stale ``quant_scales``
+    harmless: applying the same Model to full-precision variables after a
+    quantized InterfaceWrapper touched it must not scale unquantized
+    weights."""
+    scales = getattr(ctx, "quant_scales", None)
+    if scales and data.dtype == jnp.int8 and name in scales:
+        scaled = data.astype(jnp.float32) * scales[name]
+        return scaled.astype(calc_dtype)
+    return data.astype(calc_dtype)

@@ -427,11 +427,17 @@ class TextDataset:
 
 class Prefetcher:
     """Background-thread prefetch: overlap host decode with device compute
-    (the reference serialized infeed after the step, run.py:251-256)."""
+    (the reference serialized infeed after the step, run.py:251-256).
+
+    ``close()`` releases an abandoned prefetcher: without it the fill
+    thread stays blocked on its full queue forever, pinning the source
+    iterator's open file buffers (measured skewing co-resident
+    measurements badly — scripts/bench_loader.py)."""
 
     def __init__(self, iterable, depth: int = 2):
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._done = object()
+        self._stop = False
         self.thread = threading.Thread(target=self._fill, args=(iterable,),
                                        daemon=True)
         self.thread.start()
@@ -439,9 +445,29 @@ class Prefetcher:
     def _fill(self, iterable):
         try:
             for item in iterable:
-                self.q.put(item)
+                while not self._stop:
+                    try:
+                        self.q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop:
+                    return
         finally:
-            self.q.put(self._done)
+            try:
+                self.q.put_nowait(self._done)
+            except queue.Full:
+                pass
+
+    def close(self):
+        """Stop the fill thread and drop queued items; idempotent."""
+        self._stop = True
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+        self.thread.join(timeout=5)
 
     def __iter__(self):
         return self

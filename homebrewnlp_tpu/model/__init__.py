@@ -292,6 +292,7 @@ class Model:
               stats_sink: typing.Optional[list] = None) -> LossInfo:
         assert self.plan is not None, "call init() first (or assign .plan)"
         ctx = scope.Context("apply", params=variables, rng_key=rng, mesh=mesh)
+        ctx.quant_scales = getattr(self, "quant_scales", None)
         ctx.stats_sink = stats_sink
         with scope.context(ctx):
             args = self._named_inputs(batch)
@@ -433,6 +434,7 @@ class Model:
                             p.sequence_dim.name, caches,
                             cache_dtype=p.decode_cache_dtype, model_params=p)
         ctx = scope.Context("apply", params=variables, mesh=mesh, decode=state)
+        ctx.quant_scales = getattr(self, "quant_scales", None)
         decode_dims = [Dim(d.name, 1) if d.name == p.sequence_dim.name else d
                        for d in p.token_dim_shape]
         with scope.context(ctx):
@@ -467,6 +469,7 @@ class Model:
                              p.sequence_dim.name,
                              cache_dtype=p.decode_cache_dtype, model_params=p)
         ctx = scope.Context("apply", params=variables, mesh=mesh)
+        ctx.quant_scales = getattr(self, "quant_scales", None)
         ctx.prefill = state
 
         def _output_blocks(params, out):

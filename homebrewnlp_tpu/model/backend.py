@@ -97,7 +97,8 @@ def get_var(args: BlockArgs, shape: SHAPE, initializer) -> NamedTensor:
         ctx.touched.append(canonical)
     data = ctx.params[canonical]
     from ..core.tensor import nt
-    return nt(data.astype(params.calculation_dtype), shape)
+    return nt(scope.materialize_param(ctx, canonical, data,
+                                      params.calculation_dtype), shape)
 
 
 def orthogonal_var(args: BlockArgs, shape: SHAPE,

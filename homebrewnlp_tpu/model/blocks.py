@@ -65,16 +65,23 @@ class ReplayBlock:
         outer_decode = None
         outer_prefill = None
         outer_sink = None
+        outer_quant = None
         if scope.in_context():
             outer_rng = scope.current().rng_key
             outer_mesh = scope.current().mesh
             outer_decode = scope.current().decode
             outer_prefill = scope.current().prefill
             outer_sink = scope.current().stats_sink
+            outer_quant = getattr(scope.current(), "quant_scales", None)
         ctx = scope.Context("apply", params=subset, rng_key=None,
                             mesh=outer_mesh, decode=outer_decode)
         ctx.prefill = outer_prefill
         ctx.stats_sink = outer_sink
+        # int8 serving scales key on ABSOLUTE parameter names, which the
+        # per-block subsets preserve — without this, replayed blocks (the
+        # scan/decode/prefill paths, i.e. every real serving path) would
+        # consume raw -127..127 integers
+        ctx.quant_scales = outer_quant
         # attention-output stash channel (collect/provide), handed EXPLICITLY
         # by the strategy code — never inherited from the outer context, so
         # a mode can't leak across custom_vjp replay boundaries

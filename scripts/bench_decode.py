@@ -30,6 +30,9 @@ def main():
                     help="time-to-first-token: prompt fills positions "
                          "0..seq-2 (seq-1 tokens), generate ONE token, "
                          "prefill vs per-token walk")
+    ap.add_argument("--quantized", action="store_true",
+                    help="weight-only int8 (infer/quant.py): halves the "
+                         "weight bytes the decode matvecs stream per token")
     args = ap.parse_args()
 
     import jax
@@ -56,6 +59,11 @@ def main():
         x = np.zeros((batch, seq, tps), np.int32)
         variables = model.init({"token_x": x, "token_y": x})
         variables = {k: jnp.asarray(v) for k, v in variables.items()}
+        if args.quantized:
+            from homebrewnlp_tpu.infer.quant import quantize_variables
+            variables, scales = quantize_variables(variables,
+                                                   model.param_dims)
+            model.quant_scales = scales
         token_x = jnp.zeros((batch, seq, tps), jnp.int32)
         if args.ttft:
             # prompt fills all but the last position; end after ONE generated

@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Flagship (32big_mixer) structural A/B harness — round 5's attack on the
+26.4k tokens/sec plateau (VERDICT r4 next-round #2).
+
+The round-2/3 traces bound the recipe at XLA's fusion plan: dot fusions
+55%, weight-grad reductions 22% (measured NON-separable — the pallas norm
+backward regressed 24%, docs/PERFORMANCE.md round 3), backward ≈ 74% of
+the step with revnet's recompute making it structurally ~3.2× forward.
+The remaining levers are STRUCTURAL, not kernel-level: how much recompute
+the backward performs (memory strategy), how often the scan-over-layers
+round-trips the shared-weight gradient accumulator (scan_unroll), and the
+batch/memory trade those choices unlock.  This harness measures each
+variant in a fresh subprocess (clean HBM) and prints one JSON line per
+variant plus a ranked summary.
+
+Usage: python scripts/bench_flagship_ab.py [--variants name,name,...]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# name -> config overrides on bench.py's BENCH_CONFIG
+VARIANTS = {
+    "baseline": {},
+    # fewer scan iterations -> fewer shared-grad accumulator round-trips
+    # (the 'shared' attention weights accumulate cotangents across all 32
+    # depth iterations of the backward scan)
+    "unroll2": {"scan_unroll": 2},
+    "unroll4": {"scan_unroll": 4},
+    "unroll8": {"scan_unroll": 8},
+    # no recompute at all: backward drops from ~3.2x fwd toward ~2x fwd if
+    # the stacked residuals fit; the scan stores per-layer carries
+    "none_b32": {"memory_reduction_strategy": "none"},
+    "none_b16": {"memory_reduction_strategy": "none", "train_batch_size": 16},
+    "ckpt_b32": {"memory_reduction_strategy": "checkpoint"},
+    # momentum strategy: same invertibility class as revnet, one stream
+    "momentum_b32": {"memory_reduction_strategy": "momentum"},
+    # revnet without scan (unrolled): lets XLA fuse across block boundaries
+    # at the cost of compile time; round 1 measured scan ~= unrolled but
+    # that predates the fused-norm/backward work
+    "unrolled_b32": {"scan_layers": False},
+    # larger batch under revnet: amortise per-step fixed costs (scan
+    # carries, optimizer, infeed) over more tokens if the transient
+    # attention maps still fit
+    "revnet_b48": {"train_batch_size": 48},
+    "revnet_b64": {"train_batch_size": 64},
+    "revnet_b96": {"train_batch_size": 96},
+    "revnet_b128": {"train_batch_size": 128},
+}
+
+WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.path.join(%(here)r, ".."))
+import numpy as np
+import jax
+import jax.numpy as jnp
+sys.path.insert(0, %(here)r)
+from homebrewnlp_tpu.config import ModelParameter
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer
+sys.path.insert(0, os.path.join(%(here)r, ".."))
+import importlib
+bench = importlib.import_module("bench")
+
+cfg = dict(bench.BENCH_CONFIG)
+cfg.update(json.loads(%(overrides)r))
+cfg["model_path"] = "/tmp/bench_ab_run"
+params = ModelParameter(cfg)
+model = Model(params)
+trainer = Trainer(params, model)
+rng = np.random.default_rng(0)
+
+def make_batch():
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    return {"token_x": jnp.asarray(x),
+            "token_y": jnp.asarray((x + 1) %% params.vocab_size)}
+
+state = trainer.init_state(make_batch())
+for _ in range(2):
+    state, metrics = trainer.step(state, make_batch())
+float(metrics["loss"])
+batches = [make_batch() for _ in range(10)]
+t0 = time.time()
+for b in batches:
+    state, metrics = trainer.step(state, b)
+final = float(metrics["loss"])
+dt = time.time() - t0
+tokens = 10 * params.train_batch_size * params.sequence_length
+print(json.dumps({"variant": %(name)r,
+                  "tokens_per_sec_chip": round(tokens / dt, 1),
+                  "ms_per_step": round(dt * 100, 1),
+                  "batch": params.train_batch_size,
+                  "final_loss": final}))
+"""
+
+
+def run_variant(name: str, overrides: dict, timeout: int = 900):
+    code = WORKER % {"here": HERE, "overrides": json.dumps(overrides),
+                     "name": name}
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"variant": name, "error": "timeout"}
+    out = None
+    for line in proc.stdout.splitlines():
+        try:
+            out = json.loads(line)
+        except ValueError:
+            continue
+    if out is None:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return {"variant": name, "error": f"rc={proc.returncode}",
+                "stderr_tail": tail}
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", default=",".join(VARIANTS))
+    args = ap.parse_args()
+    results = []
+    for name in args.variants.split(","):
+        name = name.strip()
+        if name not in VARIANTS:
+            print(f"unknown variant {name!r}", file=sys.stderr)
+            continue
+        res = run_variant(name, VARIANTS[name])
+        print(json.dumps(res), flush=True)
+        results.append(res)
+    ok = [r for r in results if "tokens_per_sec_chip" in r]
+    ok.sort(key=lambda r: -r["tokens_per_sec_chip"])
+    print(json.dumps({"ranked": [(r["variant"], r["tokens_per_sec_chip"])
+                                 for r in ok]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
