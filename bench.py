@@ -219,42 +219,50 @@ def main() -> int:
     # companion succeeds and this one when it dies
     print(json.dumps(out), flush=True)
 
+    def companion(label: str, prefix: str, run_fn, keys=()):
+        """Run one companion bench, merge its result under ``prefix`` onto
+        the headline line, re-print the enriched line.  Returns False when
+        the companion failed (the printed line so far still stands)."""
+        try:
+            res = run_fn()
+        except Exception as exc:
+            print(f"{label} companion bench failed: {exc}", file=sys.stderr)
+            return False
+        out[f"{prefix}_tokens_per_sec_chip"] = res["value"]
+        for key, dst in (("metric", f"{prefix}_metric"),
+                         ("mfu", f"{prefix}_mfu"),
+                         ("mfu_causal", f"{prefix}_mfu_causal"),
+                         *keys):
+            if key in res:
+                out[dst] = res[key]
+        print(json.dumps(out), flush=True)
+        return True
+
     # long-context companion measurement (seq 16,384 on TPU; shrunk on CPU —
     # its 'metric' string names the actual sequence length): the flagship
     # line alone would hide the framework's long-context throughput
     # (BASELINE.md 'Long context')
-    try:
-        state = trainer = batches = None  # free HBM before the 16k compile
-        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
-        import bench_long_context as lc
-        lc_out = lc.run()
-        out["long_context_tokens_per_sec_chip"] = lc_out["value"]
-        out["long_context_metric"] = lc_out["metric"]
-        if "mfu" in lc_out:
-            out["long_context_mfu"] = lc_out["mfu"]
-        if "mfu_causal" in lc_out:
-            out["long_context_mfu_causal"] = lc_out["mfu_causal"]
-        print(json.dumps(out), flush=True)
-    except Exception as exc:
-        print(f"long-context companion bench failed: {exc}", file=sys.stderr)
+    state = trainer = batches = None  # free HBM before the 16k compile
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+    import bench_long_context as lc
+    if not companion("long-context", "long_context", lc.run):
         return 0
 
-    # 32k companion (TPU only — the CPU fallback would shrink to the same
-    # shape as the 16k companion): the longest context one chip trains.
-    # The fused backward admits its 4.3GB dq-partial buffer through the
-    # memory-aware default cap (flash_attention._fused_dqp_cap) — no env
-    # override needed since round 5
     if jax.default_backend() != "cpu":
-        try:
-            lc32 = lc.run(seq=32768)
-            out["long_context_32k_tokens_per_sec_chip"] = lc32["value"]
-            if "mfu" in lc32:
-                out["long_context_32k_mfu"] = lc32["mfu"]
-            if "mfu_causal" in lc32:
-                out["long_context_32k_mfu_causal"] = lc32["mfu_causal"]
-            print(json.dumps(out), flush=True)
-        except Exception as exc:
-            print(f"32k companion bench failed: {exc}", file=sys.stderr)
+        # 32k companion (TPU only — the CPU fallback would shrink to the
+        # same shape as the 16k companion): the longest context one chip
+        # trains; the fused backward admits its 4.3GB dq-partial buffer
+        # through the memory-aware default cap — no env override needed
+        companion("32k", "long_context_32k", lambda: lc.run(seq=32768))
+
+        # routed-MoE companion: the EP component's standing throughput
+        # number (configs/moe_mixer.json, BASELINE.md round 5)
+        def run_moe():
+            import bench_moe
+            return bench_moe.run()
+        companion("moe", "moe", run_moe,
+                  keys=(("expert_utilization_min",
+                         "moe_expert_utilization_min"),))
     return 0
 
 

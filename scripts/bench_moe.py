@@ -102,7 +102,8 @@ def run(steps: int = 10) -> dict:
         drop = [float(np.mean(s["dropped_fraction"])) for s in stats.values()
                 if "dropped_fraction" in s]
         if util:
-            out["expert_utilization_min"] = round(sum(util) / len(util), 4)
+            # true floor: the worst expert of the worst layer
+            out["expert_utilization_min"] = round(min(util), 4)
         if drop:
             out["dropped_fraction_mean"] = round(sum(drop) / len(drop), 4)
     except Exception as exc:
