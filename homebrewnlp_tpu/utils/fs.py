@@ -256,7 +256,16 @@ class GCSFS(_ObjectStoreFS):
 
     def _read(self, key):
         bucket, name = self._split(key)
-        return bucket.blob(name).download_as_bytes()
+        try:
+            return bucket.blob(name).download_as_bytes()
+        except Exception as e:
+            # the cloud client surfaces a missing blob as
+            # google.api_core.exceptions.NotFound, not FileNotFoundError —
+            # translate so gs:// behaves like every other backend of the
+            # seam (consumers catch FileNotFoundError)
+            if type(e).__name__ == "NotFound":
+                raise FileNotFoundError(key) from e
+            raise
 
     def _write(self, key, data):
         bucket, name = self._split(key)

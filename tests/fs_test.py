@@ -20,8 +20,9 @@ def _fresh(path="mem://bucket/run"):
     return memfs, path
 
 
-def fs_primitives_test():
-    memfs, base = _fresh()
+def exercise_primitives(base):
+    """Shared object-store contract sequence — run over mem:// here and
+    over the faked gs:// backend in fs_gcs_test.py."""
     with fs.open_(fs.join(base, "a/b.txt"), "w") as f:
         f.write("hello")
     assert fs.exists(fs.join(base, "a/b.txt"))
@@ -41,10 +42,9 @@ def fs_primitives_test():
         assert f.read() == "hello world"
 
 
-def glob_not_recursive_test():
+def exercise_glob_not_recursive(base):
     """'*' must not cross '/' on object stores (LocalFS.glob parity):
     nested stale objects must not match a dataset's 'dir/*' pattern."""
-    _, base = _fresh("mem://bucket/data")
     for key in ("a_10.tfrecord", "b_20.tfrecord", "old/c_30.tfrecord",
                 "tmp/partial.bin"):
         with fs.open_(fs.join(base, key), "w") as f:
@@ -53,6 +53,16 @@ def glob_not_recursive_test():
     assert got == [fs.join(base, "a_10.tfrecord"),
                    fs.join(base, "b_20.tfrecord")], got
     assert fs.glob(fs.join(base, "*.tfrecord")) == got
+
+
+def fs_primitives_test():
+    _, base = _fresh()
+    exercise_primitives(base)
+
+
+def glob_not_recursive_test():
+    _, base = _fresh("mem://bucket/data")
+    exercise_glob_not_recursive(base)
 
 
 def replace_copies_marker_last_test():
