@@ -64,6 +64,10 @@ class Context:
         # name -> tuple[Dim] recorded at init; consumed by the optimizer's
         # shape-based heuristics and the sharding planner
         self.param_dims: typing.Dict[str, tuple] = {}
+        # name -> tuple of contracted-dim NAMES (the linear's fan-in),
+        # recorded at init when the initializer knows them; consumed by
+        # serving quantization to pick safe per-channel scale axes
+        self.param_fan_in: typing.Dict[str, tuple] = {}
         # arbitrary cross-layer caches (shared-variable machinery etc.)
         self.cache: typing.Dict[str, typing.Any] = {}
         # when not None, layers append (scope_path, {stat: scalar}) tuples
@@ -170,6 +174,9 @@ def get_param(name_leaf: str, dims, initializer, slice_dtype, calc_dtype
         # touches an accelerator.
         ctx.params[name] = value.astype(slice_dtype)
         ctx.param_dims[name] = dims
+        fan_in = getattr(initializer, "fan_in_names", None)
+        if fan_in:
+            ctx.param_fan_in[name] = tuple(fan_in)
     if name not in ctx.params:
         raise KeyError(f"parameter {name} missing from provided params")
     if ctx.touched is not None and name not in ctx.touched:

@@ -63,7 +63,7 @@ class InterfaceWrapper:
             # batch-1 decode is weight-read bound, int8 halves the bytes
             from .quant import quantize_variables
             self.variables, scales = quantize_variables(
-                variables, model.param_dims)
+                variables, model.param_dims, model.param_fan_in)
             model.quant_scales = scales
         self.tokenizer = Tokenizer(params)
         # decode-call counter: the REST batching test pins that N concurrent
@@ -87,6 +87,7 @@ class InterfaceWrapper:
             # full host-numpy copy of every parameter per new width
             m.plan = self.model.plan
             m.param_dims = dict(self.model.param_dims)
+            m.param_fan_in = dict(getattr(self.model, "param_fan_in", {}))
             m.quant_scales = getattr(self.model, "quant_scales", None)
             self._width_models[width] = (p, m)
         return self._width_models[width]

@@ -245,6 +245,9 @@ class Model:
         self.params = params
         self.plan: typing.Optional[typing.Tuple[BlockSpec, ...]] = None
         self.param_dims: typing.Dict[str, tuple] = {}
+        # contracted-dim names per parameter (core/scope.py param_fan_in);
+        # serving quantization's safe scale axes
+        self.param_fan_in: typing.Dict[str, tuple] = {}
 
     def _named_inputs(self, batch: typing.Dict[str, jax.Array]):
         p = self.params
@@ -283,6 +286,7 @@ class Model:
         jax.eval_shape(_run, {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                               for k, v in batch.items() if v is not None})
         self.param_dims = dict(ctx.param_dims)
+        self.param_fan_in = dict(ctx.param_fan_in)
         return ctx.params
 
     def apply(self, variables: typing.Dict[str, jax.Array],

@@ -36,6 +36,11 @@ class OrthogonalInit:
         if fan_in_dims is None:
             fan_in_dims = []
         self.sizes = [d.size for d in shape]
+        # contracted-dim names, recorded per parameter at init: serving
+        # quantization (infer/quant.py) scales per-channel over every
+        # NON-contracted axis, which needs to know which axes the consuming
+        # einsum sums over
+        self.fan_in_names = tuple(d.name for d in fan_in_dims)
         fan_in = int(np.prod([d.size for d in fan_in_dims])) if fan_in_dims else 1
         fan_out = int(np.prod(self.sizes)) // fan_in
         self.transpose = fan_out > fan_in
@@ -91,6 +96,9 @@ def get_var(args: BlockArgs, shape: SHAPE, initializer) -> NamedTensor:
                            dtype=np.float32)
         ctx.params[canonical] = value.astype(params.slice_dtype)
         ctx.param_dims[canonical] = tuple(shape)
+        fan_in = getattr(initializer, "fan_in_names", None)
+        if fan_in:
+            ctx.param_fan_in[canonical] = tuple(fan_in)
     if canonical not in ctx.params:
         raise KeyError(f"shared parameter {canonical} missing")
     if ctx.touched is not None and canonical not in ctx.touched:

@@ -61,8 +61,9 @@ def main():
         variables = {k: jnp.asarray(v) for k, v in variables.items()}
         if args.quantized:
             from homebrewnlp_tpu.infer.quant import quantize_variables
-            variables, scales = quantize_variables(variables,
-                                                   model.param_dims)
+            variables, scales = quantize_variables(
+                variables, model.param_dims,
+                getattr(model, "param_fan_in", None))
             model.quant_scales = scales
         token_x = jnp.zeros((batch, seq, tps), jnp.int32)
         if args.ttft:

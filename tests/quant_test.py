@@ -36,18 +36,33 @@ def _built(**kw):
 
 def quantize_variables_selects_matmul_weights_test():
     params, model, variables, _ = _built()
-    qvars, scales = quantize_variables(variables, model.param_dims)
+    qvars, scales = quantize_variables(variables, model.param_dims,
+                                       model.param_fan_in)
     assert set(qvars) == set(variables)
     quantized = [k for k, v in qvars.items() if v.dtype == jnp.int8]
     assert quantized, "no weight was quantized"
     assert set(quantized) == set(scales)
+    multi_channel = 0
     for k in quantized:
         assert "embed" not in k
         assert np.size(variables[k]) >= 1 << 16
-        # round-trip error bounded by half a quantization step
         w = np.asarray(variables[k], np.float32)
-        back = np.asarray(qvars[k], np.float32) * float(scales[k])
-        assert np.max(np.abs(w - back)) <= float(scales[k]) * 0.5 + 1e-7
+        s = np.asarray(scales[k], np.float32)
+        # per-channel scales: each axis is either fully covered (a
+        # non-contracted axis the consuming einsum keeps) or reduced to 1
+        # (a contracted axis — a channel scale there could not commute out
+        # of the sum); scales must stay a small fraction of the weight
+        assert s.ndim == w.ndim
+        assert all(a in (1, b) for a, b in zip(s.shape, w.shape)), \
+            (s.shape, w.shape)
+        assert s.size * 4 <= w.size  # f32 scales <= 1/4 of the int8 bytes
+        multi_channel += sum(a > 1 for a in s.shape) > 1
+        # round-trip error bounded by half a quantization step per channel
+        back = np.asarray(qvars[k], np.float32) * s
+        assert np.all(np.abs(w - back) <= s * 0.5 + 1e-7)
+    # the fan-in record makes at least some weights carry scales over more
+    # than one non-contracted axis (e.g. new = (heads, features_per_head))
+    assert multi_channel, "fan-in-aware scales never went beyond last-axis"
     small = [k for k, v in qvars.items() if v.dtype != jnp.int8]
     assert small, "everything was quantized (norm/small vars should stay)"
 
@@ -58,7 +73,8 @@ def quantized_forward_loss_close_test():
     just mechanically wired)."""
     params, model, variables, batch = _built()
     full = float(model.apply(variables, batch).total_loss.data)
-    qvars, scales = quantize_variables(variables, model.param_dims)
+    qvars, scales = quantize_variables(variables, model.param_dims,
+                                       model.param_fan_in)
     model.quant_scales = scales
     try:
         quant = float(model.apply(qvars, batch).total_loss.data)
@@ -76,7 +92,8 @@ def quantized_scale_reaches_replayed_blocks_test():
     make a silently-dropped per-tensor scale nearly invisible to the loss,
     so the loss-parity test alone cannot catch it)."""
     params, model, variables, batch = _built(depth=2, scan_layers=True)
-    qvars, scales = quantize_variables(variables, model.param_dims)
+    qvars, scales = quantize_variables(variables, model.param_dims,
+                                       model.param_fan_in)
     model.quant_scales = scales
     try:
         with_scale = float(model.apply(qvars, batch).total_loss.data)
@@ -88,13 +105,45 @@ def quantized_scale_reaches_replayed_blocks_test():
         "zeroing the quant scales changed nothing — scales are being dropped"
 
 
+def quantized_scan_unrolled_equivalence_test():
+    """Scan-over-layers resolves every depth's params under the depth-0
+    canonical names, so scales must be depth-shared (joint amax): the
+    quantized model's loss must be IDENTICAL under scan_layers True/False.
+    Before the shared-scale fix, scan silently applied depth-0's channel
+    pattern to every depth while unrolled used per-depth scales — the two
+    paths disagreed (a per-depth corruption test alone cannot see it
+    because the scan never reads depth>0 scale entries at all)."""
+    losses = {}
+    for scan in (True, False):
+        params, model, variables, batch = _built(depth=4, scan_layers=scan)
+        qvars, scales = quantize_variables(variables, model.param_dims,
+                                           model.param_fan_in)
+        # sibling depths share one scale object, and the canonical name
+        # (what the scan replay looks up) is present
+        import re
+        canon_keys = [k for k in scales if "block0_" in k]
+        assert canon_keys
+        deeper = [k for k in scales if re.search(r"block[1-9]", k)]
+        assert deeper, "depth>0 scale entries missing"
+        for k in deeper:
+            c = re.sub(r"block\d+_", "block0_", k)
+            assert scales[c] is scales[k], (k, "scale not depth-shared")
+        model.quant_scales = scales
+        try:
+            losses[scan] = float(model.apply(qvars, batch).total_loss.data)
+        finally:
+            model.quant_scales = None
+    assert losses[True] == losses[False], losses
+
+
 def stale_scales_ignore_full_precision_weights_test():
     """A Model whose quant_scales were set by a quantized wrapper must
     apply cleanly to FULL-PRECISION variables: the dtype gate in
     materialize_param scales only int8 data."""
     params, model, variables, batch = _built()
     full = float(model.apply(variables, batch).total_loss.data)
-    _, scales = quantize_variables(variables, model.param_dims)
+    _, scales = quantize_variables(variables, model.param_dims,
+                                       model.param_fan_in)
     model.quant_scales = scales  # stale: variables below are NOT quantized
     try:
         again = float(model.apply(variables, batch).total_loss.data)
@@ -108,7 +157,8 @@ def quantized_decode_internal_consistency_test():
     full-forward sampler produce identical greedy tokens — the cache
     machinery sees quantized layers transparently."""
     params, model, variables, batch = _built()
-    qvars, scales = quantize_variables(variables, model.param_dims)
+    qvars, scales = quantize_variables(variables, model.param_dims,
+                                       model.param_fan_in)
     model.quant_scales = scales
     try:
         prompt = np.asarray(batch["token_x"])[:, :4, 0]
@@ -133,7 +183,8 @@ def quantized_sharded_decode_parity_test():
     params, model, variables, batch = _built(
         heads=4, train_batch_size=4,
         mesh_shape_override={"data": 2, "model": 4})
-    qvars, scales = quantize_variables(variables, model.param_dims)
+    qvars, scales = quantize_variables(variables, model.param_dims,
+                                       model.param_fan_in)
     model.quant_scales = scales
     try:
         prompt = np.asarray(batch["token_x"])[:, :4, 0]
