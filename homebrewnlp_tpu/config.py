@@ -260,6 +260,17 @@ class ModelParameter:
         # token; dequantize fuses into the dots.  Off by default (greedy
         # tokens can differ from full precision by quantization error)
         self.serve_quantized_weights = False
+        # ---- fault tolerance (docs/RELIABILITY.md) ----
+        # N > 0: a non-finite (nan/inf) loss skips that step's update (the
+        # jitted step selects the old state on-device) and the run aborts
+        # with a diagnostic after N CONSECUTIVE non-finite losses.  Costs one
+        # device sync per step to read the loss; 0 = off (reference parity)
+        self.nonfinite_loss_tolerance = 0
+        # retry budget for transient storage errors (GCS 503s, connection
+        # resets) at every GCSFS primitive and checkpoint fs call site:
+        # exponential backoff from base_delay, jittered (utils/retry.py)
+        self.storage_retry_attempts = 5
+        self.storage_retry_base_delay = 0.5
 
         self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():
@@ -270,6 +281,17 @@ class ModelParameter:
 
         # ---- validation / derivation (reference :189-271)
         assert self.macro_batching > 0, "macro_batching must be >= 1"
+        if self.nonfinite_loss_tolerance < 0:
+            raise ValueError("nonfinite_loss_tolerance must be >= 0 "
+                             f"(0 = off), got {self.nonfinite_loss_tolerance}")
+        if self.storage_retry_attempts < 1:
+            raise ValueError("storage_retry_attempts must be >= 1, got "
+                             f"{self.storage_retry_attempts}")
+        if self.storage_retry_base_delay < 0:
+            # time.sleep raises on negatives — the typo would replace every
+            # retry with a ValueError masking the real storage error
+            raise ValueError("storage_retry_base_delay must be >= 0, got "
+                             f"{self.storage_retry_base_delay}")
         # the serving-default repetition penalty reaches _repetition_penalty
         # whenever a request omits a value (sample mode, REPL, batched
         # rows); r <= 0 would inf/NaN seen tokens' logits — apply the same

@@ -438,6 +438,7 @@ class Prefetcher:
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._done = object()
         self._stop = False
+        self._error: typing.Optional[BaseException] = None
         self.thread = threading.Thread(target=self._fill, args=(iterable,),
                                        daemon=True)
         self.thread.start()
@@ -453,11 +454,21 @@ class Prefetcher:
                         continue
                 if self._stop:
                     return
+        except BaseException as e:  # noqa: BLE001 — re-raised in the consumer
+            # capture for __next__: the done sentinel below would otherwise
+            # make a decode/IO crash indistinguishable from dataset
+            # exhaustion, and train() would exit cleanly at the wrong step
+            self._error = e
         finally:
-            try:
-                self.q.put_nowait(self._done)
-            except queue.Full:
-                pass
+            # the sentinel must not be dropped on a momentarily-full queue
+            # (the consumer would drain the real items then block forever);
+            # same bounded-wait put as the items, abandoned only on close()
+            while not self._stop:
+                try:
+                    self.q.put(self._done, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
 
     def close(self):
         """Stop the fill thread and drop queued items; idempotent."""
@@ -475,6 +486,9 @@ class Prefetcher:
     def __next__(self):
         item = self.q.get()
         if item is self._done:
+            if self._error is not None:
+                error, self._error = self._error, None
+                raise error
             raise StopIteration
         return item
 

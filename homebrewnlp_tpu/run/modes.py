@@ -14,6 +14,7 @@ from ..core import sharding as shardlib
 from ..infer.interface import InterfaceWrapper, Tokenizer, debug_similarity, query_repl
 from ..model import Model
 from ..train import checkpoint as ckpt
+from .train_loop import PREEMPTED_EXIT_CODE
 from .train_loop import train as train_loop
 
 
@@ -59,7 +60,10 @@ def _load_model(params: ModelParameter, batch_size: int = 1):
     model = Model(params)
     batch = _dummy_batch(params, batch_size=batch_size)
     variables = model.init(batch)
-    restored = ckpt.restore(params.model_path)
+    # corruption fallback: serve the newest COMPLETE checkpoint instead of
+    # crashing on a torn latest one (train_loop resumes the same way);
+    # strict = an all-corrupt model_path refuses to serve random init
+    restored = ckpt.restore_latest_valid(params.model_path, strict=True)
     if restored:
         loaded, _, step, _ = restored
         variables = {k: np.asarray(loaded[k]).astype(variables[k].dtype)
@@ -80,6 +84,12 @@ def _load_model(params: ModelParameter, batch_size: int = 1):
 def train_mode(params: ModelParameter, args):
     result = train_loop(params)
     print(result)
+    if result.get("preempted"):
+        # distinct exit code: the emergency checkpoint is written and the
+        # run is resumable — scripts/run_manager.py relaunches on this code
+        # instead of declaring the run finished
+        return PREEMPTED_EXIT_CODE
+    return 0
 
 
 def sample_mode(params: ModelParameter, args):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import time
 import typing
 
@@ -27,7 +28,52 @@ from ..train import Trainer
 from ..train import checkpoint as ckpt
 from ..train.metrics import MetricLogger
 from ..utils import fs
+from ..utils import retry as retry_mod
 from .analysis import analyze_model
+
+#: exit code of a run that stopped on SIGTERM/SIGINT after writing its
+#: emergency checkpoint — resumable, not a crash.  143 = 128+SIGTERM, what an
+#: unhandled TERM would have produced, so generic supervisors treat it the
+#: same; scripts/run_manager.py recognises it and relaunches instead of
+#: declaring the run finished (keep the two constants in sync).
+PREEMPTED_EXIT_CODE = 143
+
+
+class NonFiniteLossError(RuntimeError):
+    """``nonfinite_loss_tolerance`` consecutive non-finite losses: the run
+    aborts (after the finally-path emergency checkpoint of the last GOOD
+    state) instead of training on poisoned weights."""
+
+
+class _ShutdownFlag:
+    """SIGTERM/SIGINT handler: request a graceful stop.  The loop finishes
+    the in-flight step, then the finally path writes the emergency
+    checkpoint and rewrites the run log — the run exits resumable."""
+
+    def __init__(self):
+        self.requested = False
+        self.signum: typing.Optional[int] = None
+
+    def __call__(self, signum, frame):
+        if self.requested:
+            # second signal: the operator insists (e.g. the emergency save
+            # is itself hung on storage retries) — restore the default
+            # disposition and re-deliver so the process actually dies
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signum = signum
+        # os.write, not print: a signal landing mid-print would make
+        # buffered stdout raise "reentrant call" in the main thread, turning
+        # the graceful path into a crash
+        try:
+            os.write(2, (f"received {signal.Signals(signum).name}: "
+                         "finishing the in-flight step, then writing an "
+                         "emergency checkpoint (repeat to force-exit)\n"
+                         ).encode())
+        except OSError:
+            pass
 
 
 def _dump_run_config(params: ModelParameter):
@@ -149,6 +195,12 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     """profile_steps=(start, stop): capture a jax.profiler trace of those
     steps into <model_path>/profile (SURVEY.md §5.1 — the reference had no
     op-level profiler integration)."""
+    # transient-storage retry budget for this run's checkpoint/GCS traffic
+    # (utils/retry.py; every fs call site in train/checkpoint.py + every
+    # GCSFS primitive reads this policy at call time)
+    retry_mod.set_default_policy(retry_mod.RetryPolicy(
+        max_attempts=params.storage_retry_attempts,
+        base_delay=params.storage_retry_base_delay))
     devices = jax.devices()
     mesh = shardlib.build_mesh(params) if len(devices) > 1 else None
     model = Model(params)
@@ -161,7 +213,27 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     if is_chief:
         _dump_run_config(params)
 
-    restored = ckpt.restore(params.model_path) if params.use_checkpointing else None
+    # restore through the corruption fallback: a torn/corrupt latest
+    # checkpoint costs one checkpoint interval, not the run; strict = an
+    # all-corrupt model_path refuses to train from scratch over the corpse
+    restored = ckpt.restore_latest_valid(params.model_path, strict=True) \
+        if params.use_checkpointing else None
+    if params.use_checkpointing and jax.process_count() > 1:
+        # all hosts must resume from the SAME step: a host whose torn read
+        # made it fall back further than its peers would desync current_step
+        # and deadlock the step-tagged barriers of the distributed save.
+        # The chief's choice wins (its fallback warnings are the visible
+        # ones); hosts re-restore when they disagree.
+        local_step = restored[2] if restored else -1
+        try:
+            from jax.experimental import multihost_utils
+            agreed = int(multihost_utils.broadcast_one_to_all(
+                np.asarray(local_step, np.int32)))
+        except Exception:
+            agreed = local_step  # no cross-host collectives (CPU tests)
+        if agreed != local_step:
+            restored = ckpt.restore(params.model_path, agreed) \
+                if agreed >= 0 else None
     params.current_step = restored[2] if restored else ckpt.latest_step(params.model_path)
 
     data = make_dataset(params, mesh=mesh)
@@ -212,8 +284,61 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                        * params.macro_batching)
     start_step = int(state.step)
     steps_done = 0
+    # sub-batches actually fed to the device, INCLUDING non-finite-skipped
+    # steps (their batches are consumed without an update): the DataLog
+    # resume replay must skip exactly this many, or a resumed run would
+    # re-feed the skipped batches and shift every later one
+    consumed = 0
+    it_count = 0
     last_metrics: typing.Dict[str, float] = {}
     t_start = time.time()
+    # preemption-safe shutdown: TPU preemptions deliver SIGTERM; finish the
+    # in-flight step, write the emergency checkpoint (finally path), exit
+    # resumable.  Previous handlers are restored on the way out; outside the
+    # main thread (no signal access) training simply runs unguarded.
+    shutdown = _ShutdownFlag()
+    prev_handlers: typing.Dict[int, typing.Any] = {}
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev_handlers[sig] = signal.signal(sig, shutdown)
+    except ValueError:
+        prev_handlers = {}
+    nonfinite_streak = 0
+    stopped = False
+    nproc = jax.process_count()
+    broadcast_ok = [True]
+    # pods agree on the stop at this iteration cadence: a blocking broadcast
+    # EVERY iteration would serialise host dispatch against compute (the
+    # same per-step-sync trap the step_now mirror avoids); every 16th costs
+    # ~nothing and delays a graceful stop by at most 16 steps of the
+    # preemption grace window
+    stop_sync_every = 16
+
+    def should_stop(it: int) -> bool:
+        """Pod-wide agreement on the graceful stop.  Hosts receive SIGTERM
+        at different loop ticks; if each broke at its own step, the peers'
+        in-flight step collectives and the step-tagged barriers of the
+        distributed emergency save would never match — a silent deadlock in
+        exactly the preemption window this path exists for.  The chief's
+        flag decides for everyone, checked on a deterministic iteration
+        cadence identical across hosts (free single-process)."""
+        if nproc <= 1 or not broadcast_ok[0]:
+            return shutdown.requested
+        if it % stop_sync_every:
+            # between agreement points a pod host must NOT act on its local
+            # flag: breaking alone is exactly the deadlock being prevented
+            return False
+        try:
+            from jax.experimental import multihost_utils
+            return bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(shutdown.requested)))
+        except Exception:
+            # multiprocess CPU (the test topology) has no cross-host
+            # collectives: fall back to the per-process flag — symmetric
+            # across hosts, probed once
+            broadcast_ok[0] = False
+            return shutdown.requested
+
     try:
         batch = first_batch
         data_it = iter(data)
@@ -230,7 +355,40 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 elif profiling and step_now >= profile_steps[1]:
                     jax.profiler.stop_trace()
                     profiling = False
+            it_count += 1
             state, metrics = trainer.step(state, batch)
+            consumed += params.macro_batching
+            if params.nonfinite_loss_tolerance > 0:
+                # the jitted step already SKIPPED the update on-device for a
+                # non-finite loss (train/__init__.py select); here the host
+                # mirrors that skip, tracks the consecutive streak, and
+                # aborts once it exhausts the tolerance.  Reading the loss
+                # costs one device sync per step — documented in CONFIG.md.
+                loss_now = float(np.asarray(jax.device_get(metrics["loss"])))
+                if not np.isfinite(loss_now):
+                    nonfinite_streak += 1
+                    print(f"WARNING: non-finite loss ({loss_now}) at step "
+                          f"{step_now}; update skipped "
+                          f"({nonfinite_streak}/"
+                          f"{params.nonfinite_loss_tolerance} consecutive)",
+                          flush=True)
+                    if nonfinite_streak >= params.nonfinite_loss_tolerance:
+                        raise NonFiniteLossError(
+                            f"aborting: {nonfinite_streak} consecutive "
+                            f"non-finite losses (last {loss_now}) at step "
+                            f"{step_now}; last good state is step "
+                            f"{step_now} (emergency checkpoint follows). "
+                            "Suspects: learning rate spike, corrupt batch, "
+                            "fp16/bf16 overflow")
+                    if should_stop(it_count):
+                        stopped = True
+                        break
+                    try:
+                        batch = next(data_it)
+                    except StopIteration:
+                        break
+                    continue
+                nonfinite_streak = 0
             steps_done += params.macro_batching
             step_now += params.macro_batching
             if params.debug_train_step:
@@ -274,25 +432,44 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     step_now % params.steps_per_checkpoint < params.macro_batching:
                 ckpt.save(params.model_path, step_now, state.variables,
                           state.opt_state, params.max_checkpoints_keep)
+            if should_stop(it_count):
+                # graceful preemption: the in-flight step finished; fall
+                # through to the finally path's emergency checkpoint + run
+                # log rewrite, then report resumable-exit to the caller
+                stopped = True
+                break
     finally:
-        if profile_steps is not None and profiling:
-            jax.profiler.stop_trace()
-        if params.use_checkpointing:
-            ckpt.save(params.model_path, int(state.step), state.variables,
-                      state.opt_state, params.max_checkpoints_keep)
-        # rewrite the run log entry with the steps actually consumed
-        log = read_runs_log(params) \
-            if is_chief and not params.use_random_dataloader else None
-        if log:
-            log[-1]["steps"] = steps_done
-            with fs.open_(fs.join(params.model_path, "DataLog.log"), "w") as f:
-                for entry in log:
-                    f.write(json.dumps(entry) + "\n")
-        if logger is not None:
-            logger.close()
+        # the graceful handlers stay installed until the END of this block —
+        # restoring them first would let a second SIGTERM/SIGINT kill the
+        # process mid-emergency-save, losing exactly the checkpoint this
+        # path exists to write
+        try:
+            if profile_steps is not None and profiling:
+                jax.profiler.stop_trace()
+            if params.use_checkpointing:
+                ckpt.save(params.model_path, int(state.step), state.variables,
+                          state.opt_state, params.max_checkpoints_keep)
+            # rewrite the run log entry with the steps actually consumed
+            log = read_runs_log(params) \
+                if is_chief and not params.use_random_dataloader else None
+            if log:
+                log[-1]["steps"] = consumed
+                with fs.open_(fs.join(params.model_path, "DataLog.log"), "w") as f:
+                    for entry in log:
+                        f.write(json.dumps(entry) + "\n")
+            if logger is not None:
+                logger.close()
+        finally:
+            for sig, handler in prev_handlers.items():
+                signal.signal(sig, handler)
     wall = time.time() - t_start
+    if stopped:
+        print(f"preempted at step {int(state.step)}: emergency checkpoint "
+              f"written; exit {PREEMPTED_EXIT_CODE} resumes from here",
+              flush=True)
     return {"steps": steps_done, "wall_s": wall,
             "final_step": int(state.step),
+            "preempted": stopped,
             "tokens_per_sec": steps_done * params.train_batch_size
             * params.sequence_length / max(wall, 1e-9),
             **{f"final_{k}": v for k, v in last_metrics.items()}}

@@ -234,6 +234,17 @@ class Trainer:
             else:
                 carry, metrics = self._micro_step(carry, (batch, rng))
             variables, opt_state, step = carry
+            if p.nonfinite_loss_tolerance > 0:
+                # non-finite loss guard: select the PRE-step state on-device
+                # (the input state is donated, so the host cannot keep the
+                # old buffers around to roll back to — the skip must live
+                # inside the jitted step).  The step counter is part of the
+                # select: a skipped update advances nothing.
+                ok = jnp.isfinite(metrics["loss"])
+                variables, opt_state, step = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    (variables, opt_state, step),
+                    (state.variables, state.opt_state, state.step))
             return TrainState(variables, opt_state, step), metrics
 
         return jax.jit(step_fn, donate_argnums=(0,))

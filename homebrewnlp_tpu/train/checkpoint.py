@@ -14,19 +14,121 @@ fetches serialize on the device queue and pay a round trip each; one giant
 fetch would double peak host RAM) and written one file per array — on a
 multi-host pod each process saves only addressable shards (process index
 recorded in the manifest), tensorstore-style.
+
+Fault tolerance (docs/RELIABILITY.md): every fs call site runs under the
+process-wide ``utils.retry`` policy (transient storage errors back off and
+retry); manifests record a byte length + crc32c per array file which
+``restore`` verifies; any corruption/truncation/missing-file surfaces as
+``CheckpointError`` naming the checkpoint directory, and
+``restore_latest_valid`` walks past broken checkpoints to the newest
+complete one instead of crashing the run.
 """
 from __future__ import annotations
 
 import json
 import re
 import typing
+import zlib
 
 import jax
 import numpy as np
 
 from ..utils import fs
+from ..utils import retry as retry_mod
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)$")
+
+
+class CheckpointError(Exception):
+    """A specific checkpoint is corrupt, truncated, or incomplete.  Carries
+    the checkpoint directory so callers (``restore_latest_valid``) can skip
+    past it; distinct from transient storage errors, which the retry policy
+    has already exhausted by the time anything raises."""
+
+    def __init__(self, message: str, ckpt_dir: str = ""):
+        super().__init__(message)
+        self.ckpt_dir = ckpt_dir
+
+
+# -- retrying fs helpers -----------------------------------------------------
+
+def _with_retry(path, thunk):
+    """Run one fs operation under the process-wide retry policy — unless
+    the backend serving ``path`` retries inside its own primitives (GCSFS):
+    stacking both layers would square the attempt budget into minutes-long
+    hangs per op during an outage."""
+    if getattr(fs.for_path(str(path)), "retries_internally", False):
+        return thunk()
+    return retry_mod.default_policy().call(thunk)
+
+
+def _fsop(fn, *args):
+    """One fs call under the retry dispatch (first arg = path)."""
+    return _with_retry(args[0], lambda: fn(*args))
+
+
+def _write_bytes(path: str, data: bytes) -> None:
+    def attempt():
+        with fs.open_(path, "wb") as f:
+            f.write(data)
+    _with_retry(path, attempt)
+
+
+def _read_bytes(path: str) -> bytes:
+    def attempt():
+        with fs.open_(path, "rb") as f:
+            return f.read()
+    return _with_retry(path, attempt)
+
+
+def _write_json(path: str, obj) -> None:
+    _write_bytes(path, json.dumps(obj).encode("utf-8"))
+
+
+# -- array-file integrity ----------------------------------------------------
+
+def _checksum(data: bytes) -> typing.Tuple[str, int]:
+    """(algo, value): the native slice-by-8 crc32c (native/recordio.cpp,
+    TFRecord masking) when the .so is available, zlib crc32 otherwise.  The
+    algo is recorded in the manifest so a checkpoint written by one build
+    verifies under another."""
+    try:
+        from ..data import native_recordio
+        crc = native_recordio.masked_crc(data)
+        if crc is not None:
+            return "crc32c-masked", int(crc)
+    except Exception:
+        pass
+    return "crc32", zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _verify_bytes(data: bytes, meta: dict, ctx: str, ckpt_dir: str) -> None:
+    """Check recorded byte length + crc; raise CheckpointError on mismatch.
+    Manifests from before integrity recording (no 'bytes'/'crc' keys) skip
+    verification — restore stays backward compatible."""
+    want_len = meta.get("bytes")
+    if want_len is not None and len(data) != int(want_len):
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: {ctx} is truncated "
+            f"({len(data)} bytes, manifest records {want_len})", ckpt_dir)
+    want_crc = meta.get("crc")
+    if want_crc is None:
+        return
+    algo = meta.get("crc_algo", "crc32")
+    if algo == "crc32c-masked":
+        try:
+            from ..data import native_recordio
+            got = native_recordio.masked_crc(data)
+        except Exception:
+            got = None
+        if got is None:  # native lib unavailable: length check stands alone
+            return
+    else:
+        got = zlib.crc32(data) & 0xFFFFFFFF
+    if int(got) != int(want_crc):
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: {ctx} fails {algo} verification "
+            f"(stored {want_crc}, computed {got})", ckpt_dir)
 
 
 def _dtype_name(dtype) -> str:
@@ -42,16 +144,16 @@ def _np_dtype(name: str):
 
 
 def list_checkpoints(model_path: str) -> typing.List[int]:
-    if not fs.isdir(model_path):
+    if not _fsop(fs.isdir, model_path):
         return []
     steps = []
-    for entry in fs.listdir(model_path):
+    for entry in _fsop(fs.listdir, model_path):
         m = _CKPT_RE.match(entry)
         if not m:
             continue
         # object-store replace is not atomic: a checkpoint is complete only
         # once its index.json (written last) exists
-        if fs.exists(fs.join(model_path, entry, "index.json")):
+        if _fsop(fs.exists, fs.join(model_path, entry, "index.json")):
             steps.append(int(m.group(1)))
     return sorted(steps)
 
@@ -109,7 +211,12 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
                                  max_keep, extra)
     ckpt_dir = fs.join(model_path, f"ckpt_{int(step)}")
     tmp_dir = ckpt_dir + ".tmp"
-    fs.makedirs(tmp_dir)
+    # a crashed earlier save may have left a stale tmp dir; its leftover
+    # files would otherwise be replaced into the final checkpoint alongside
+    # this save's (the distributed path below has always cleared it)
+    if _fsop(fs.exists, tmp_dir):
+        _fsop(fs.rmtree, tmp_dir)
+    _fsop(fs.makedirs, tmp_dir)
     manifest: typing.Dict[str, typing.Any] = {
         "step": int(step),
         "process_index": jax.process_index(),
@@ -136,22 +243,44 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
         for (idx, key, _), value in zip(chunk, fetched):
             host = np.asarray(value)
             fname = f"arr_{idx:06d}.bin"
-            with fs.open_(fs.join(tmp_dir, fname), "wb") as f:
-                f.write(host.tobytes())
+            data = host.tobytes()
+            algo, crc = _checksum(data)
+            _write_bytes(fs.join(tmp_dir, fname), data)
             manifest["arrays"][key] = {"file": fname,
                                        "shape": list(host.shape),
-                                       "dtype": _dtype_name(host.dtype)}
-    with fs.open_(fs.join(tmp_dir, "index.json"), "w") as f:
-        json.dump(manifest, f)
-    if fs.exists(ckpt_dir):
-        fs.rmtree(ckpt_dir)
+                                       "dtype": _dtype_name(host.dtype),
+                                       "bytes": len(data),
+                                       "crc": crc, "crc_algo": algo}
+    _write_json(fs.join(tmp_dir, "index.json"), manifest)
+    if _fsop(fs.exists, ckpt_dir):
+        _fsop(fs.rmtree, ckpt_dir)
+    # NOT retried at this layer: object-store replace is a multi-key
+    # copy+delete, and re-running a partially-completed one re-clears the
+    # destination then re-copies from a partially-DELETED source — a
+    # marker-complete-but-corrupt checkpoint.  Backends retry their own
+    # per-key primitives (idempotent); if replace still fails, this save is
+    # lost but the marker ordering keeps every earlier checkpoint restorable.
     fs.replace(tmp_dir, ckpt_dir)
 
-    if max_keep > 0:
-        steps = list_checkpoints(model_path)
-        for old in steps[:-max_keep]:
-            fs.rmtree(fs.join(model_path, f"ckpt_{old}"))
+    _prune(model_path, int(step), max_keep)
     return ckpt_dir
+
+
+def _prune(model_path: str, current_step: int, max_keep: int) -> None:
+    """Keep the newest ``max_keep`` checkpoints AT OR BELOW the step just
+    written, and delete any checkpoint ahead of it: after a corruption
+    fallback rewound the run, a surviving newer (corrupt) directory would
+    otherwise outrank every fresh save in the step sort — the naive
+    ``steps[:-max_keep]`` deleted the checkpoint it had just written and
+    kept the corrupt one until the run re-reached its step."""
+    if max_keep <= 0:
+        return
+    steps = list_checkpoints(model_path)
+    keep = set(s for s in steps if s <= current_step)
+    keep = set(sorted(keep)[-max_keep:])
+    for old in steps:
+        if old not in keep:
+            _fsop(fs.rmtree, fs.join(model_path, f"ckpt_{old}"))
 
 
 def multihost_utils_sync(tag: str) -> None:
@@ -168,10 +297,10 @@ def _save_distributed(model_path: str, step: int, variables, opt_state,
     # have left stale shard files in the tmp dir; restore() reads every
     # shards_*.json, so stale files would corrupt the reassembly — clear
     # before anyone writes, then barrier
-    if pid == 0 and fs.exists(tmp_dir):
-        fs.rmtree(tmp_dir)
+    if pid == 0 and _fsop(fs.exists, tmp_dir):
+        _fsop(fs.rmtree, tmp_dir)
     multihost_utils_sync(f"ckpt_clear_{step}")
-    fs.makedirs(tmp_dir)
+    _fsop(fs.makedirs, tmp_dir)
     tree = {"variables": variables, "opt_state": opt_state}
     leaves = list(_leaf_files(tree))
 
@@ -195,38 +324,43 @@ def _save_distributed(model_path: str, step: int, variables, opt_state,
     fetched_shards = jax.device_get(shard_data_refs)
     for (i, key, j, index, value), host in zip(shard_meta, fetched_shards):
         fname = f"arr_{i:06d}_p{pid}_s{j}.bin"
-        with fs.open_(fs.join(tmp_dir, fname), "wb") as f:
-            f.write(np.asarray(host).tobytes())
+        data = np.asarray(host).tobytes()
+        algo, crc = _checksum(data)
+        _write_bytes(fs.join(tmp_dir, fname), data)
         shard_entries.append({
             "key": key, "file": fname,
             "index": _slice_spec(index, value.shape),
             "global_shape": list(value.shape),
-            "dtype": _dtype_name(value.dtype)})
+            "dtype": _dtype_name(value.dtype),
+            "bytes": len(data), "crc": crc, "crc_algo": algo})
     if pid == 0:
         fetched = jax.device_get([v for _, _, v in chief_fetch])
         for (i, key, _), value in zip(chief_fetch, fetched):
             host = np.asarray(value)
             fname = f"arr_{i:06d}.bin"
-            with fs.open_(fs.join(tmp_dir, fname), "wb") as f:
-                f.write(host.tobytes())
+            data = host.tobytes()
+            algo, crc = _checksum(data)
+            _write_bytes(fs.join(tmp_dir, fname), data)
             chief_arrays[key] = {"file": fname, "shape": list(host.shape),
-                                 "dtype": _dtype_name(host.dtype)}
-    with fs.open_(fs.join(tmp_dir, f"shards_{pid}.json"), "w") as f:
-        json.dump({"process_index": pid, "shards": shard_entries}, f)
+                                 "dtype": _dtype_name(host.dtype),
+                                 "bytes": len(data),
+                                 "crc": crc, "crc_algo": algo}
+    _write_json(fs.join(tmp_dir, f"shards_{pid}.json"),
+                {"process_index": pid, "shards": shard_entries})
     if pid == 0:
-        with fs.open_(fs.join(tmp_dir, "index.json"), "w") as f:
-            json.dump({"step": int(step), "distributed": True,
-                       "process_count": jax.process_count(),
-                       "arrays": chief_arrays, "extra": extra or {}}, f)
+        _write_json(fs.join(tmp_dir, "index.json"),
+                    {"step": int(step), "distributed": True,
+                     "process_count": jax.process_count(),
+                     "arrays": chief_arrays, "extra": extra or {}})
     # every process must have flushed before the directory becomes visible
     multihost_utils_sync(f"ckpt_save_{step}")
     if pid == 0:
-        if fs.exists(ckpt_dir):
-            fs.rmtree(ckpt_dir)
+        if _fsop(fs.exists, ckpt_dir):
+            _fsop(fs.rmtree, ckpt_dir)
+        # not retried: see the single-process save (replace re-runs are not
+        # idempotent on object stores)
         fs.replace(tmp_dir, ckpt_dir)
-        if max_keep > 0:
-            for old in list_checkpoints(model_path)[:-max_keep]:
-                fs.rmtree(fs.join(model_path, f"ckpt_{old}"))
+        _prune(model_path, int(step), max_keep)
     multihost_utils_sync(f"ckpt_done_{step}")
     return ckpt_dir
 
@@ -234,6 +368,10 @@ def _save_distributed(model_path: str, step: int, variables, opt_state,
 def restore(model_path: str, step: typing.Optional[int] = None
             ) -> typing.Optional[typing.Tuple[dict, dict, int, dict]]:
     """-> (variables, opt_state, step, extra) or None if no checkpoint.
+
+    Verifies the manifest's recorded byte length + crc for every array file;
+    any corruption, truncation, or missing file raises ``CheckpointError``
+    naming the checkpoint directory (``restore_latest_valid`` consumes it).
 
     Distributed checkpoints reassemble full host arrays from the per-process
     shard files (every process reads every shard — shard_params re-lays them
@@ -244,27 +382,40 @@ def restore(model_path: str, step: typing.Optional[int] = None
             return None
         step = steps[-1]
     ckpt_dir = fs.join(model_path, f"ckpt_{int(step)}")
-    with fs.open_(fs.join(ckpt_dir, "index.json")) as f:
-        manifest = json.load(f)
+    try:
+        return _restore_verified(ckpt_dir)
+    except CheckpointError:
+        raise
+    except (FileNotFoundError, json.JSONDecodeError, UnicodeDecodeError,
+            KeyError, ValueError, TypeError, EOFError) as e:
+        # a truncated index.json / missing shard file / malformed manifest
+        # must name the checkpoint, not surface as a bare decode error
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir} is corrupt or incomplete: "
+            f"{type(e).__name__}: {e}", ckpt_dir) from e
+
+
+def _restore_verified(ckpt_dir: str) -> typing.Tuple[dict, dict, int, dict]:
+    manifest = json.loads(_read_bytes(fs.join(ckpt_dir, "index.json"))
+                          .decode("utf-8"))
     tree: dict = {"variables": {}, "opt_state": {}}
     for key, meta in manifest["arrays"].items():
-        with fs.open_(fs.join(ckpt_dir, meta["file"]), "rb") as f:
-            raw = f.read()
+        raw = _read_bytes(fs.join(ckpt_dir, meta["file"]))
+        _verify_bytes(raw, meta, meta["file"], ckpt_dir)
         arr = np.frombuffer(raw, dtype=_np_dtype(meta["dtype"]))
         arr = arr.reshape(meta["shape"]).copy()
         _set_leaf(tree, key, arr)
     if manifest.get("distributed"):
         assembled: typing.Dict[str, np.ndarray] = {}
-        for mpath in fs.glob(fs.join(ckpt_dir, "shards_*.json")):
-            with fs.open_(mpath) as f:
-                shard_manifest = json.load(f)
+        for mpath in _fsop(fs.glob, fs.join(ckpt_dir, "shards_*.json")):
+            shard_manifest = json.loads(_read_bytes(mpath).decode("utf-8"))
             for entry in shard_manifest["shards"]:
                 key = entry["key"]
                 if key not in assembled:
                     assembled[key] = np.empty(entry["global_shape"],
                                               _np_dtype(entry["dtype"]))
-                with fs.open_(fs.join(ckpt_dir, entry["file"]), "rb") as f:
-                    raw = f.read()
+                raw = _read_bytes(fs.join(ckpt_dir, entry["file"]))
+                _verify_bytes(raw, entry, entry["file"], ckpt_dir)
                 idx = tuple(slice(lo, hi) for lo, hi in entry["index"])
                 part = np.frombuffer(raw, dtype=_np_dtype(entry["dtype"]))
                 assembled[key][idx] = part.reshape(
@@ -273,3 +424,31 @@ def restore(model_path: str, step: typing.Optional[int] = None
             _set_leaf(tree, key, arr)
     return (tree["variables"], tree.get("opt_state", {}),
             int(manifest["step"]), manifest.get("extra", {}))
+
+
+def restore_latest_valid(model_path: str, strict: bool = False
+                         ) -> typing.Optional[typing.Tuple[dict, dict, int, dict]]:
+    """``restore`` with corruption fallback: walk ``list_checkpoints``
+    newest-first past corrupt/truncated/incomplete checkpoints and return the
+    newest COMPLETE one, or None when no valid checkpoint exists.  The train
+    loop resumes through this, so one torn write costs one checkpoint
+    interval of progress instead of the run.
+
+    ``strict``: when checkpoints EXIST but none restored cleanly, raise
+    instead of returning None — production callers (training, serving) want
+    this, because proceeding means silently training from, or serving,
+    random initialization over the remains of a run."""
+    steps = list_checkpoints(model_path)
+    for step in reversed(steps):
+        try:
+            return restore(model_path, step)
+        except CheckpointError as e:
+            print(f"WARNING: {e}; falling back to an earlier checkpoint",
+                  flush=True)
+    if strict and steps:
+        raise CheckpointError(
+            f"{model_path} has {len(steps)} checkpoint(s) but none restored "
+            "cleanly; refusing to proceed from random initialization over a "
+            "corrupt run (repair or clear the directory to start over)",
+            model_path)
+    return None

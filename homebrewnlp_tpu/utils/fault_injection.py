@@ -1,0 +1,95 @@
+"""Deterministic storage fault injection over the fs seam.
+
+``FaultInjectionFS`` wraps an object-store backend (normally ``MemFS``) at
+the four primitives every other operation is built from — ``_keys`` /
+``_read`` / ``_write`` / ``_delete`` — and injects faults from a
+deterministic schedule keyed on a monotonically increasing OP INDEX:
+
+* ``crash_at = K``: the K-th primitive raises ``InjectedFault`` BEFORE
+  executing — the moment the process dies.  Because ``fs.replace`` on an
+  object store expands into many ``_write``/``_delete`` primitives, a sweep
+  over every index also crashes MID-replace and MID-prune, the exact windows
+  the checkpoint completeness-marker ordering exists for.
+* ``transient = {K: M}``: the K-th primitive raises ``InjectedTransient``
+  (classified retryable by ``utils.retry``) M times, then succeeds — proving
+  the retry seam absorbs GCS-style 503 bursts.  Failed attempts do NOT
+  consume the op index, so schedules stay stable under retries.
+* ``truncate = {K: N}``: if the K-th primitive is a write, only the first N
+  bytes land — a silently torn write, the case checkpoint crc verification
+  exists for.
+
+Register it like any backend and every consumer of the seam runs against it
+unchanged::
+
+    fi = FaultInjectionFS(crash_at=7)
+    fs.register("fault", fi)
+    checkpoint.save("fault://bucket/run", ...)   # dies at primitive #7
+
+``ops`` records every successfully-issued primitive, so a clean dry run
+measures how many crash points an operation sequence has
+(tests/fault_injection_test.py sweeps all of them).
+"""
+from __future__ import annotations
+
+import typing
+
+from . import fs as fslib
+from .retry import TransientError
+
+
+class InjectedFault(RuntimeError):
+    """Permanent injected failure: simulates the process dying at (or the
+    storage service hard-failing) a specific operation index."""
+
+
+class InjectedTransient(TransientError, ConnectionError):
+    """Retryable injected failure (a GCS 503 / connection reset stand-in)."""
+
+
+class FaultInjectionFS(fslib._ObjectStoreFS):
+    def __init__(self, inner: typing.Optional[fslib._ObjectStoreFS] = None,
+                 crash_at: typing.Optional[int] = None,
+                 transient: typing.Optional[typing.Dict[int, int]] = None,
+                 truncate: typing.Optional[typing.Dict[int, int]] = None):
+        inner = inner if inner is not None else fslib.MemFS()
+        assert isinstance(inner, fslib._ObjectStoreFS), \
+            "FaultInjectionFS schedules faults at object-store primitives"
+        self.inner = inner
+        self.crash_at = crash_at
+        self.transient = dict(transient or {})
+        self.truncate = dict(truncate or {})
+        self.op_index = 0
+        self.ops: typing.List[typing.Tuple[str, str]] = []
+
+    def _before(self, op: str, key: str) -> int:
+        i = self.op_index
+        remaining = self.transient.get(i, 0)
+        if remaining > 0:
+            self.transient[i] = remaining - 1
+            raise InjectedTransient(
+                f"injected transient failure at op {i} ({op} {key})")
+        if self.crash_at is not None and i == self.crash_at:
+            raise InjectedFault(f"injected crash at op {i} ({op} {key})")
+        self.op_index += 1
+        self.ops.append((op, key))
+        return i
+
+    # -- the four object-store primitives, fault-gated -----------------------
+    def _keys(self, prefix):
+        self._before("keys", prefix)
+        return self.inner._keys(prefix)
+
+    def _read(self, key):
+        self._before("read", key)
+        return self.inner._read(key)
+
+    def _write(self, key, data):
+        i = self._before("write", key)
+        keep = self.truncate.get(i)
+        if keep is not None:
+            data = bytes(data)[:keep]
+        self.inner._write(key, data)
+
+    def _delete(self, key):
+        self._before("delete", key)
+        self.inner._delete(key)

@@ -16,6 +16,9 @@ the same role is a small registry keyed on URL scheme:
   semantics (prefix listing, non-atomic directory replace implemented as
   ordered copy+delete, no true append) used by tests to prove consumers
   survive remote-storage behaviour.
+* ``FaultInjectionFS`` (utils/fault_injection.py) — wraps any object-store
+  backend and injects crashes / transient errors / torn writes from a
+  deterministic schedule; registered the same way (docs/RELIABILITY.md).
 
 Object-store note: ``replace`` of a directory is NOT atomic on object
 stores.  Consumers that need crash-safety order their writes so a
@@ -53,6 +56,11 @@ class FileSystem:
 
     #: True when paths are plain local paths C extensions can open directly
     is_local = False
+
+    #: True when the backend already retries transient failures inside its
+    #: own primitives (GCSFS): higher layers skip their retry wrapper so
+    #: attempt budgets never nest multiplicatively
+    retries_internally = False
 
 
 class LocalFS(FileSystem):
@@ -124,12 +132,24 @@ class _ObjectStoreFS(FileSystem):
                     self.write(initial)
 
             def flush(self):
+                if self.closed:
+                    return
                 data = self.getvalue()
                 fs._write(path, data if binary else data.encode("utf-8"))
 
             def close(self):
-                self.flush()
-                super().close()
+                # commit exactly once: io.IOBase.__del__ calls close(), so
+                # without the closed guard an abandoned writer (e.g. a
+                # failed attempt inside a retry loop) would re-upload its
+                # stale buffer at GC time — possibly over a newer
+                # successful write.  super().close() runs even when the
+                # commit raises, so the destructor never replays it.
+                if self.closed:
+                    return
+                try:
+                    self.flush()
+                finally:
+                    super().close()
 
             def __exit__(self, *exc):
                 self.close()
@@ -230,7 +250,18 @@ class MemFS(_ObjectStoreFS):
 
 
 class GCSFS(_ObjectStoreFS):
-    """gs:// via the optional google-cloud-storage package."""
+    """gs:// via the optional google-cloud-storage package.
+
+    Every primitive (the network boundary) runs under the process-wide
+    ``utils.retry`` policy: transient GCS failures (503/429/connection
+    resets) back off and retry; permanent ones (NotFound -> translated
+    FileNotFoundError, permissions) surface immediately.
+
+    ``retries_internally`` tells higher layers (the checkpoint fs call
+    sites) not to stack a second retry loop on top — nesting would square
+    the attempt budget into minutes-long hangs per op during an outage."""
+
+    retries_internally = True
 
     def __init__(self):
         try:
@@ -241,12 +272,20 @@ class GCSFS(_ObjectStoreFS):
                 "dependency (pip install google-cloud-storage)") from e
         self._client = storage.Client()
 
+    @staticmethod
+    def _retry(fn, *args):
+        from . import retry
+        return retry.default_policy().call(fn, *args)
+
     def _split(self, key):
         rest = key[len("gs://"):]
         bucket, _, name = rest.partition("/")
         return self._client.bucket(bucket), name
 
     def _keys(self, prefix):
+        return self._retry(self._keys_once, prefix)
+
+    def _keys_once(self, prefix):
         bucket, name = self._split(prefix)
         out = [f"gs://{bucket.name}/{b.name}"
                for b in bucket.list_blobs(prefix=name)]
@@ -255,6 +294,9 @@ class GCSFS(_ObjectStoreFS):
                 or (prefix.endswith("/") and k.startswith(prefix))]
 
     def _read(self, key):
+        return self._retry(self._read_once, key)
+
+    def _read_once(self, key):
         bucket, name = self._split(key)
         try:
             return bucket.blob(name).download_as_bytes()
@@ -262,18 +304,33 @@ class GCSFS(_ObjectStoreFS):
             # the cloud client surfaces a missing blob as
             # google.api_core.exceptions.NotFound, not FileNotFoundError —
             # translate so gs:// behaves like every other backend of the
-            # seam (consumers catch FileNotFoundError)
+            # seam (consumers catch FileNotFoundError), and so the retry
+            # policy classifies it permanent instead of burning its budget
             if type(e).__name__ == "NotFound":
                 raise FileNotFoundError(key) from e
             raise
 
     def _write(self, key, data):
+        self._retry(self._write_once, key, bytes(data))
+
+    def _write_once(self, key, data):
         bucket, name = self._split(key)
-        bucket.blob(name).upload_from_string(bytes(data))
+        bucket.blob(name).upload_from_string(data)
 
     def _delete(self, key):
+        self._retry(self._delete_once, key)
+
+    def _delete_once(self, key):
         bucket, name = self._split(key)
-        bucket.blob(name).delete()
+        try:
+            bucket.blob(name).delete()
+        except Exception as e:
+            # delete is idempotent: a retry after a committed-but-lost
+            # response (connection reset after the server applied it) sees
+            # NotFound — that is success, not an error
+            if type(e).__name__ == "NotFound":
+                return
+            raise
 
 
 _local = LocalFS()

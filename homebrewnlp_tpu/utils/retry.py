@@ -1,0 +1,134 @@
+"""Retry policy for flaky remote storage (the fault model of docs/RELIABILITY.md).
+
+The reference stack leaned on TF1's GFile to absorb transient GCS errors; the
+fs seam (utils/fs.py) has no such cushion, so one 503 mid-checkpoint killed a
+pod-scale run.  This module is the cushion: exponential backoff + jitter with
+a per-operation attempt budget, applied
+
+* inside every ``GCSFS`` primitive (the network boundary), and
+* around every fs call site in ``train/checkpoint.py`` (so non-GCS remote
+  backends registered through ``fs.register`` get the same protection).
+
+Only TRANSIENT errors are retried.  Classification is structural (exception
+type / errno / HTTP status attribute) rather than import-based so the google
+client libraries stay optional.  Permanent errors — missing objects, bad
+permissions, corrupt data — surface immediately; retrying those only delays
+the real diagnostic.
+
+The clock (``sleep``) and jitter source (``rng``) are injectable so tests run
+the full retry schedule deterministically with zero wall-clock sleeps
+(tests/retry_test.py, tests/fault_injection_test.py).
+"""
+from __future__ import annotations
+
+import errno
+import random
+import time
+import typing
+
+
+class TransientError(Exception):
+    """Explicitly-retryable failure.  Raised by backends that already know an
+    error is transient (and by the fault-injection harness's
+    ``InjectedTransient``)."""
+
+
+#: google-cloud / requests / urllib3 transient exception TYPE NAMES — matched
+#: by name so the optional dependencies never need importing here.
+_TRANSIENT_TYPE_NAMES = frozenset({
+    "ServiceUnavailable", "TooManyRequests", "InternalServerError",
+    "BadGateway", "GatewayTimeout", "DeadlineExceeded", "RetryError",
+    "TransportError", "ChunkedEncodingError", "ProtocolError",
+    "IncompleteRead", "RemoteDisconnected",
+})
+
+_TRANSIENT_HTTP_CODES = frozenset({408, 429, 500, 502, 503, 504})
+
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EAGAIN, errno.ETIMEDOUT, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.ECONNREFUSED, errno.EPIPE, errno.EIO, errno.ENETUNREACH,
+    errno.ENETRESET, errno.EHOSTUNREACH,
+})
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient (retry) vs permanent (raise immediately) classification."""
+    if isinstance(exc, TransientError):
+        return True
+    # precise permanent subclasses of OSError first: a missing checkpoint
+    # shard must not burn the whole backoff budget before surfacing
+    if isinstance(exc, (FileNotFoundError, FileExistsError, IsADirectoryError,
+                        NotADirectoryError, PermissionError)):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS:
+        return True
+    if type(exc).__name__ in _TRANSIENT_TYPE_NAMES:
+        return True
+    code = getattr(exc, "code", None)
+    if not isinstance(code, int):
+        code = getattr(exc, "status_code", None)
+    return isinstance(code, int) and code in _TRANSIENT_HTTP_CODES
+
+
+class RetryPolicy:
+    """Exponential backoff + jitter with a hard attempt budget.
+
+    ``delay(n) = min(max_delay, base_delay * multiplier**n) * (1 + jitter*u)``
+    with ``u ~ rng.random()`` — jitter de-synchronises a pod's worth of hosts
+    all retrying the same flaky bucket at once.  ``sleep`` and ``rng`` are
+    injectable for deterministic tests."""
+
+    def __init__(self, max_attempts: int = 5, base_delay: float = 0.5,
+                 max_delay: float = 30.0, multiplier: float = 2.0,
+                 jitter: float = 0.25,
+                 sleep: typing.Callable[[float], None] = time.sleep,
+                 rng: typing.Optional[random.Random] = None,
+                 classify: typing.Callable[[BaseException], bool] = is_transient):
+        assert max_attempts >= 1
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.sleep = sleep
+        self.rng = rng if rng is not None else random.Random()
+        self.classify = classify
+
+    def backoff(self, attempt: int) -> float:
+        base = min(self.max_delay, self.base_delay * self.multiplier ** attempt)
+        return base * (1.0 + self.jitter * self.rng.random())
+
+    def call(self, fn: typing.Callable, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying transient failures up to the
+        attempt budget.  The last error (or any permanent error) re-raises."""
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                if attempt >= self.max_attempts - 1 or not self.classify(e):
+                    raise
+                self.sleep(self.backoff(attempt))
+                attempt += 1
+
+_default: typing.Optional[RetryPolicy] = None
+
+
+def default_policy() -> RetryPolicy:
+    """The process-wide policy used by GCSFS and the checkpoint fs call
+    sites.  Looked up at CALL time (never cached by consumers) so
+    ``set_default_policy`` swaps take effect everywhere at once."""
+    global _default
+    if _default is None:
+        _default = RetryPolicy()
+    return _default
+
+
+def set_default_policy(policy: typing.Optional[RetryPolicy]) -> None:
+    """Install the process-wide policy (``train()`` derives one from the
+    ``storage_retry_attempts`` / ``storage_retry_base_delay`` config knobs;
+    tests install a no-sleep policy).  ``None`` resets to defaults."""
+    global _default
+    _default = policy

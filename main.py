@@ -39,8 +39,14 @@ def main():
     from homebrewnlp_tpu.config import ModelParameter
     from homebrewnlp_tpu.run.modes import RUN_MODE_FNS
     from homebrewnlp_tpu.train import checkpoint as ckpt
+    from homebrewnlp_tpu.utils import retry
 
     params = ModelParameter(config)
+    # storage retry knobs apply to EVERY run mode (serving restores through
+    # the same flaky bucket as training; train() re-installs identically)
+    retry.set_default_policy(retry.RetryPolicy(
+        max_attempts=params.storage_retry_attempts,
+        base_delay=params.storage_retry_base_delay))
     params.debug_gradients = args.debug_grad
     # CLI --workers overrides the config (reference src/main.py:60) — but
     # only when actually passed, so web_workers in the JSON stays effective
@@ -52,8 +58,10 @@ def main():
         params.use_autoregressive_sampling = True
     params.current_step = ckpt.latest_step(params.model_path)
 
-    RUN_MODE_FNS[args.run_mode](params, args)
-    return 0
+    # train_mode returns PREEMPTED_EXIT_CODE (143) after a SIGTERM-triggered
+    # emergency checkpoint so supervisors relaunch instead of finishing
+    rc = RUN_MODE_FNS[args.run_mode](params, args)
+    return int(rc) if rc else 0
 
 
 if __name__ == "__main__":

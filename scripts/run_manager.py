@@ -23,6 +23,14 @@ import subprocess
 import sys
 import tempfile
 import time
+import typing
+
+
+#: training exits with this code after a SIGTERM-triggered emergency
+#: checkpoint (homebrewnlp_tpu/run/train_loop.py PREEMPTED_EXIT_CODE — kept
+#: as a literal here so the manager never imports jax): a clean preemption,
+#: to be relaunched, not a finished or crashed run
+PREEMPTED_RC = 143
 
 
 def sh(cmd: str) -> subprocess.CompletedProcess:
@@ -122,10 +130,26 @@ class Manager:
             self.log.flush()
         open(self._spool_path, "w").close()  # consumed
 
-    def kill(self, proc: subprocess.Popen):
+    def kill(self, proc: subprocess.Popen,
+             grace: typing.Optional[int] = None):
+        # SIGTERM now triggers a GRACEFUL stop in training (finish the step,
+        # write the emergency checkpoint — potentially minutes for GB-scale
+        # state on gs://); a fixed short TERM->KILL gap would tear exactly
+        # the checkpoint the preemption path exists to write.  Callers pass
+        # a SHORT grace for a wedged (stalled) process that will never
+        # honour the graceful flag.
+        if grace is None:
+            grace = getattr(self.args, "term_grace", 600)
         try:
             os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
-            time.sleep(10)
+        except ProcessLookupError:
+            return
+        try:
+            proc.wait(timeout=grace)
+            return
+        except subprocess.TimeoutExpired:
+            self.out(f"no exit {grace}s after SIGTERM; escalating to SIGKILL")
+        try:
             os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
         except ProcessLookupError:
             pass
@@ -141,20 +165,32 @@ class Manager:
             healthy = self.tpu_healthy()
             stalled = (self.args.stall_timeout > 0
                        and self.heartbeat_age() > self.args.stall_timeout)
-            if proc.poll() is not None:
+            rc = proc.poll()  # snapshot once: the process may exit mid-tick
+            preempted = rc == PREEMPTED_RC
+            if rc is not None and not preempted:
                 if healthy:
-                    self.out(f"training exited rc={proc.returncode}; done")
+                    self.out(f"training exited rc={rc}; done")
                     break
                 # process died because the TPU went away — fall through
-            if healthy and not stalled:
+            if preempted:
+                # clean, resumable exit: relaunch WITHOUT consuming the
+                # crash budget (max_restarts bounds crash loops, and a
+                # preemption is not a crash)
+                self.out(f"training exited rc={PREEMPTED_RC}: clean "
+                         "preemption (emergency checkpoint written); "
+                         "relaunching")
+            elif healthy and not stalled:
                 continue
-            restarts += 1
-            if 0 < self.args.max_restarts < restarts:
-                self.out("max restarts exceeded; giving up")
-                break
-            self.out(f"unhealthy={not healthy} stalled={stalled}; "
-                     f"restarting (#{restarts})")
-            self.kill(proc)
+            else:
+                restarts += 1
+                if 0 < self.args.max_restarts < restarts:
+                    self.out("max restarts exceeded; giving up")
+                    break
+                self.out(f"unhealthy={not healthy} stalled={stalled}; "
+                         f"restarting (#{restarts})")
+            # a stalled (wedged) process never honours the graceful flag:
+            # don't park the fleet manager on the full checkpoint grace
+            self.kill(proc, grace=15 if stalled else None)
             time.sleep(60)
             self.create_tpu(recreate=not healthy)
             proc = self.launch()
@@ -174,6 +210,10 @@ def main():
     ap.add_argument("--poll-interval", type=int, default=300)
     ap.add_argument("--poll-jitter", type=int, default=300)
     ap.add_argument("--stall-timeout", type=int, default=3600)
+    ap.add_argument("--term-grace", type=int, default=600, dest="term_grace",
+                    help="seconds to wait after SIGTERM for the training "
+                         "process to finish its emergency checkpoint "
+                         "before SIGKILL")
     ap.add_argument("--max-restarts", type=int, default=0, help="0 = unlimited")
     Manager(ap.parse_args()).run()
 

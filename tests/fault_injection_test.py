@@ -1,0 +1,313 @@
+"""Storage fault-injection harness (utils/fault_injection.py) against the
+checkpoint save/replace/prune sequence: the acceptance sweep crashes
+``checkpoint.save`` at EVERY fs-primitive index and proves
+``restore_latest_valid`` always returns a complete checkpoint — never an
+exception, never mixed state.  Plus: transient errors absorbed by the retry
+seam, torn writes caught by length/crc verification, CheckpointError
+wrapping, stale-tmp cleanup, and fallback walking.  All deterministic:
+injected schedules, injected clock/rng, zero wall-clock sleeps."""
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from backend import make_params  # noqa: F401  (CPU env bootstrap)
+from homebrewnlp_tpu.train import checkpoint as ckpt
+from homebrewnlp_tpu.train.checkpoint import CheckpointError
+from homebrewnlp_tpu.utils import fs, retry
+from homebrewnlp_tpu.utils.fault_injection import (FaultInjectionFS,
+                                                   InjectedFault,
+                                                   InjectedTransient)
+
+BASE = "fault://bucket/run"
+
+
+@pytest.fixture(autouse=True)
+def no_sleep_retry():
+    """Deterministic no-wall-clock retry policy for every test here; the
+    recorded sleeps prove the backoff schedule actually ran."""
+    old = retry.default_policy()
+    sleeps = []
+    retry.set_default_policy(retry.RetryPolicy(
+        max_attempts=4, base_delay=0.01, sleep=sleeps.append,
+        rng=random.Random(0)))
+    yield sleeps
+    retry.set_default_policy(old)
+
+
+def _install(**faults) -> FaultInjectionFS:
+    fi = FaultInjectionFS(**faults)
+    fs.register("fault", fi)
+    return fi
+
+
+def _state(step: int):
+    """Step-derived values so cross-checkpoint mixing is detectable."""
+    variables = {"w/a": jnp.full((4, 3), float(step), jnp.float32),
+                 "w/b": jnp.arange(7, dtype=jnp.float32) * step}
+    opt_state = {"w/a": {"m": jnp.full((4, 3), step * 10.0, jnp.float32)}}
+    return variables, opt_state
+
+
+def _assert_restored(restored, allowed_steps):
+    assert restored is not None
+    got_v, got_o, step, _ = restored
+    assert step in allowed_steps, step
+    np.testing.assert_array_equal(np.asarray(got_v["w/a"], np.float32),
+                                  np.full((4, 3), float(step), np.float32))
+    np.testing.assert_array_equal(np.asarray(got_v["w/b"], np.float32),
+                                  np.arange(7, dtype=np.float32) * step)
+    np.testing.assert_array_equal(np.asarray(got_o["w/a"]["m"], np.float32),
+                                  np.full((4, 3), step * 10.0, np.float32))
+    return step
+
+
+@pytest.mark.faultinjection
+def crash_at_every_op_sweep_test():
+    """THE acceptance sweep: with a complete step-1 checkpoint on disk, crash
+    the step-2 save (write, replace-copy, prune-delete — every primitive) at
+    every index K; restore_latest_valid must return step 1 or step 2,
+    complete and unmixed, at every crash point.  max_keep=1 so the sweep
+    also crashes mid-prune of the old checkpoint."""
+    v1, o1 = _state(1)
+    v2, o2 = _state(2)
+    # dry run: measure the op-index window of the second save
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=1)
+    start = fi.op_index
+    ckpt.save(BASE, 2, v2, o2, max_keep=1)
+    n_ops = fi.op_index - start
+    assert n_ops > 10, f"sweep window suspiciously small: {n_ops} ops"
+
+    fell_back = 0
+    for k in range(n_ops):
+        fi = _install()
+        ckpt.save(BASE, 1, v1, o1, max_keep=1)
+        fi.crash_at = fi.op_index + k
+        with pytest.raises(InjectedFault):
+            ckpt.save(BASE, 2, v2, o2, max_keep=1)
+        fi.crash_at = None  # "restart": the next reader is a fresh process
+        step = _assert_restored(ckpt.restore_latest_valid(BASE), (1, 2))
+        fell_back += step == 1
+    # the sweep must cover both regimes: crashes before the checkpoint
+    # became complete (fall back to 1) and after (step 2 survives)
+    assert 0 < fell_back < n_ops, fell_back
+
+
+@pytest.mark.faultinjection
+def transient_errors_absorbed_test(no_sleep_retry):
+    """GCS-style 503 bursts (transient, M < budget) on every array/manifest
+    write and on the stale-tmp probe: the retry seam at the checkpoint fs
+    call sites absorbs all of them and the checkpoint lands bit-perfect.
+    (The non-idempotent directory replace is deliberately NOT retried at
+    this layer — see checkpoint.save — so the schedule targets the
+    retry-covered call sites.)"""
+    v1, o1 = _state(1)
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)  # dry run: learn the op window
+    n0 = fi.op_index
+    v2, o2 = _state(2)
+    ckpt.save(BASE, 2, v2, o2, max_keep=2)
+    targets = [n0] + [i for i, (op, key) in enumerate(fi.ops)
+                      if i >= n0 and op == "write" and ".tmp/" in key]
+    assert len(targets) >= 5  # exists-probe + 3 arrays + manifest
+
+    fi = _install(transient={i: 2 for i in targets})
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)
+    ckpt.save(BASE, 2, v2, o2, max_keep=2)  # same op schedule, now flaky
+    _assert_restored(ckpt.restore(BASE), (2,))
+    assert len(no_sleep_retry) >= 2 * len(targets)  # the backoffs ran
+
+
+@pytest.mark.faultinjection
+def transient_budget_exhaustion_test():
+    """More consecutive transients than the attempt budget: the error
+    finally surfaces (as the transient, not something masked)."""
+    v1, o1 = _state(1)
+    _install(transient={0: 99})
+    with pytest.raises(InjectedTransient):
+        ckpt.save(BASE, 1, v1, o1, max_keep=2)
+
+
+@pytest.mark.faultinjection
+def torn_write_detected_test():
+    """Truncate the tmp-dir write of each array file in turn: the recorded
+    byte length catches it at restore, and restore_latest_valid falls back
+    to the previous complete checkpoint."""
+    v1, o1 = _state(1)
+    v2, o2 = _state(2)
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)
+    base_ops = fi.op_index
+    ckpt.save(BASE, 2, v2, o2, max_keep=2)
+    arr_writes = [i for i, (op, key) in enumerate(fi.ops)
+                  if i >= base_ops and op == "write"
+                  and "ckpt_2.tmp/arr_" in key]
+    assert len(arr_writes) == 3  # w/a, w/b, opt m
+
+    for target in arr_writes:
+        _install(truncate={target: 3})
+        ckpt.save(BASE, 1, v1, o1, max_keep=2)
+        ckpt.save(BASE, 2, v2, o2, max_keep=2)  # same schedule, torn write
+        with pytest.raises(CheckpointError, match="ckpt_2"):
+            ckpt.restore(BASE, 2)
+        _assert_restored(ckpt.restore_latest_valid(BASE), (1,))
+
+
+def same_length_corruption_caught_by_crc_test():
+    """A bit flip that preserves the byte length is invisible to the length
+    check — the recorded crc must catch it (reusing the native slice-by-8
+    crc32c when available, zlib crc32 otherwise)."""
+    v1, o1 = _state(1)
+    v2, o2 = _state(2)
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)
+    ckpt.save(BASE, 2, v2, o2, max_keep=2)
+    mem = fi.inner
+    key = next(k for k in sorted(mem.objects) if "ckpt_2/arr_000000" in k)
+    blob = bytearray(mem.objects[key])
+    blob[0] ^= 0xFF
+    mem.objects[key] = bytes(blob)
+    with pytest.raises(CheckpointError, match="verification"):
+        ckpt.restore(BASE, 2)
+    _assert_restored(ckpt.restore_latest_valid(BASE), (1,))
+
+
+def truncated_index_json_is_checkpoint_error_test():
+    """Satellite: a torn index.json surfaces as CheckpointError naming the
+    checkpoint directory, not a raw JSONDecodeError."""
+    v1, o1 = _state(1)
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)
+    key = next(k for k in sorted(fi.inner.objects)
+               if k.endswith("ckpt_1/index.json"))
+    fi.inner.objects[key] = fi.inner.objects[key][:10]
+    with pytest.raises(CheckpointError) as ei:
+        ckpt.restore(BASE, 1)
+    assert "ckpt_1" in str(ei.value)
+    assert ei.value.ckpt_dir.endswith("ckpt_1")
+
+
+def missing_shard_file_is_checkpoint_error_test():
+    """Satellite: a missing array file surfaces as CheckpointError naming
+    the checkpoint directory, not a raw FileNotFoundError."""
+    v1, o1 = _state(1)
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)
+    key = next(k for k in sorted(fi.inner.objects) if "ckpt_1/arr_" in k)
+    del fi.inner.objects[key]
+    with pytest.raises(CheckpointError) as ei:
+        ckpt.restore(BASE, 1)
+    assert "ckpt_1" in str(ei.value)
+    # with nothing valid left, the fallback reports no checkpoint at all
+    assert ckpt.restore_latest_valid(BASE) is None
+
+
+def stale_tmp_cleared_before_single_process_save_test():
+    """Satellite: leftovers of a crashed earlier save in ckpt_<step>.tmp
+    (including another run's shard manifests) must not leak into the final
+    checkpoint directory (the distributed path has always cleared them)."""
+    fi = _install()
+    fi.inner._write(f"{BASE}/ckpt_5.tmp/arr_junk.bin", b"junk")
+    fi.inner._write(f"{BASE}/ckpt_5.tmp/shards_7.json", b"{}")
+    v5, o5 = _state(5)
+    ckpt.save(BASE, 5, v5, o5, max_keep=2)
+    stray = [k for k in fi.inner.objects
+             if "arr_junk" in k or "shards_7" in k]
+    assert not stray, stray
+    _assert_restored(ckpt.restore(BASE), (5,))
+
+
+def restore_latest_valid_walks_multiple_corrupt_test():
+    """The fallback walks past SEVERAL broken checkpoints (torn marker,
+    missing file) to the newest complete one."""
+    fi = _install()
+    for step in (1, 2, 3):
+        v, o = _state(step)
+        ckpt.save(BASE, step, v, o, max_keep=5)
+    objs = fi.inner.objects
+    # break 3: truncate its marker; break 2: delete an array file
+    k3 = next(k for k in sorted(objs) if k.endswith("ckpt_3/index.json"))
+    objs[k3] = objs[k3][:7]
+    k2 = next(k for k in sorted(objs) if "ckpt_2/arr_" in k)
+    del objs[k2]
+    _assert_restored(ckpt.restore_latest_valid(BASE), (1,))
+
+
+def restore_latest_valid_empty_test(tmp_path):
+    assert ckpt.restore_latest_valid(str(tmp_path / "nowhere")) is None
+
+
+def pre_integrity_manifest_still_restores_test():
+    """Manifests written before integrity recording (no bytes/crc keys)
+    restore without verification — forward compatibility of old runs."""
+    import json
+    v1, o1 = _state(1)
+    fi = _install()
+    ckpt.save(BASE, 1, v1, o1, max_keep=2)
+    key = next(k for k in sorted(fi.inner.objects)
+               if k.endswith("ckpt_1/index.json"))
+    manifest = json.loads(fi.inner.objects[key].decode())
+    for meta in manifest["arrays"].values():
+        for field in ("bytes", "crc", "crc_algo"):
+            meta.pop(field, None)
+    fi.inner.objects[key] = json.dumps(manifest).encode()
+    _assert_restored(ckpt.restore(BASE), (1,))
+
+
+def prune_never_trusts_corrupt_future_steps_test():
+    """After a corruption fallback rewound the run, pruning keeps the
+    newest max_keep checkpoints AT OR BELOW the step just written and
+    deletes the stale corrupt future directory — the naive newest-by-step
+    prune deleted the fresh save and kept the corrupt one, making the run
+    unrecoverable on the next restart."""
+    fi = _install()
+    v9, o9 = _state(9)
+    ckpt.save(BASE, 9, v9, o9, max_keep=2)
+    v12, o12 = _state(12)
+    ckpt.save(BASE, 12, v12, o12, max_keep=2)
+    key = next(k for k in sorted(fi.inner.objects) if "ckpt_12/arr_000000" in k)
+    blob = bytearray(fi.inner.objects[key])
+    blob[0] ^= 0xFF
+    fi.inner.objects[key] = bytes(blob)
+    _assert_restored(ckpt.restore_latest_valid(BASE), (9,))  # rewound
+    # the resumed run's next periodic save lands BELOW the corrupt step
+    v10, o10 = _state(10)
+    ckpt.save(BASE, 10, v10, o10, max_keep=1)
+    assert ckpt.list_checkpoints(BASE) == [10]
+    _assert_restored(ckpt.restore_latest_valid(BASE), (10,))
+
+
+def abandoned_writer_never_replays_test():
+    """A writer whose commit failed must NOT replay its stale buffer from
+    the destructor (io.IOBase.__del__ calls close()): the zombie write
+    would land at GC time, possibly over a newer successful write."""
+    import gc
+
+    fi = _install(transient={0: 1})
+    f = fs.open_(f"{BASE}/obj", "wb")
+    f.write(b"stale")
+    with pytest.raises(InjectedTransient):
+        f.close()  # bare handle, no retry wrapper: the commit just fails
+    with fs.open_(f"{BASE}/obj", "wb") as g:  # newer write succeeds
+        g.write(b"fresh")
+    del f
+    gc.collect()
+    with fs.open_(f"{BASE}/obj", "rb") as r:
+        assert r.read() == b"fresh"
+
+
+def checksum_algo_roundtrip_test():
+    """The recorded algo verifies its own output; both algos available in
+    this image must agree with a recompute."""
+    from homebrewnlp_tpu.train.checkpoint import _checksum, _verify_bytes
+    data = b"\x00\x01\x02checkpoint-bytes" * 37
+    algo, value = _checksum(data)
+    assert algo in ("crc32c-masked", "crc32")
+    meta = {"bytes": len(data), "crc": value, "crc_algo": algo}
+    _verify_bytes(data, meta, "arr", "ckpt_x")  # no raise
+    with pytest.raises(CheckpointError):
+        _verify_bytes(data[:-1], meta, "arr", "ckpt_x")
+    with pytest.raises(CheckpointError):
+        _verify_bytes(data[:-1] + b"\xff", meta, "arr", "ckpt_x")

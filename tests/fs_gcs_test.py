@@ -38,7 +38,11 @@ class _FakeBlob:
         self._store[self._key] = bytes(data)
 
     def delete(self):
-        self._store.pop(self._key, None)
+        # faithful to the real client: deleting a missing blob raises
+        # NotFound (GCSFS._delete must treat that as idempotent success)
+        if self._key not in self._store:
+            raise NotFound(f"404 blob {self._key} not found")
+        self._store.pop(self._key)
 
 
 class _FakeBucket:
@@ -103,6 +107,15 @@ def gcs_missing_blob_is_file_not_found_test(gcs):
     with pytest.raises(FileNotFoundError):
         with fs.open_("gs://bucket/absent/object") as f:
             f.read()
+
+
+def gcs_delete_idempotent_test(gcs):
+    """A retried DELETE whose first attempt committed server-side (response
+    lost) sees NotFound — that is success, not a fatal error mid-prune."""
+    gcs._write("gs://bucket/run/x", b"d")
+    gcs._delete("gs://bucket/run/x")
+    gcs._delete("gs://bucket/run/x")  # the lost-response retry: no raise
+    assert not fs.exists("gs://bucket/run/x")
 
 
 def gcs_checkpoint_roundtrip_test(gcs):

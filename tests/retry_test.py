@@ -1,0 +1,191 @@
+"""Retry policy (utils/retry.py): deterministic backoff schedule with an
+injected clock/rng, transient-vs-permanent classification, attempt budget —
+and the policy wired through the fs seam (GCSFS primitives retry a flaky
+fake client; the mem:// path retries injected transients at the checkpoint
+call sites in fault_injection_test.py)."""
+import random
+
+import pytest
+
+from backend import make_params  # noqa: F401  (CPU env bootstrap)
+from homebrewnlp_tpu.utils import retry
+
+
+class _FixedRng:
+    """rng.random() -> constant: jitter becomes exactly base * (1 + j * c)."""
+
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def random(self):
+        return self.value
+
+
+def _policy(sleeps, **kw):
+    kw.setdefault("rng", _FixedRng(0.0))
+    return retry.RetryPolicy(sleep=sleeps.append, **kw)
+
+
+def backoff_schedule_test():
+    """Exponential, capped, jittered — deterministic under injected rng."""
+    sleeps = []
+    pol = _policy(sleeps, max_attempts=6, base_delay=1.0, max_delay=8.0,
+                  multiplier=2.0, jitter=0.25, rng=_FixedRng(1.0))
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise retry.TransientError("always down")
+
+    with pytest.raises(retry.TransientError):
+        pol.call(flaky)
+    assert calls["n"] == 6  # the full attempt budget, then re-raise
+    # delays: min(8, 1*2^n) * (1 + 0.25*1.0) for n = 0..4
+    assert sleeps == [1.25, 2.5, 5.0, 10.0, 10.0]
+
+
+def transient_recovers_test():
+    sleeps = []
+    pol = _policy(sleeps, max_attempts=5, base_delay=0.5)
+    calls = {"n": 0}
+
+    def twice_down():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionResetError("peer reset")
+        return "ok"
+
+    assert pol.call(twice_down) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+
+
+def permanent_not_retried_test():
+    """FileNotFoundError & friends surface immediately — retrying a missing
+    checkpoint shard only delays the real diagnostic."""
+    sleeps = []
+    pol = _policy(sleeps)
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("gs://bucket/absent")
+
+    with pytest.raises(FileNotFoundError):
+        pol.call(missing)
+    assert calls["n"] == 1 and sleeps == []
+
+
+@pytest.mark.parametrize("exc,transient", [
+    (ConnectionResetError("reset"), True),
+    (TimeoutError("deadline"), True),
+    (BrokenPipeError("pipe"), True),
+    (retry.TransientError("explicit"), True),
+    (type("ServiceUnavailable", (Exception,), {})("503"), True),   # GCS name
+    (type("TooManyRequests", (Exception,), {})("429"), True),
+    (type("ApiError", (Exception,), {"code": 503})("503"), True),  # http attr
+    (type("ApiError", (Exception,), {"code": 404})("404"), False),
+    (FileNotFoundError("absent"), False),
+    (PermissionError("denied"), False),
+    (IsADirectoryError("dir"), False),
+    (ValueError("corrupt"), False),
+    (type("NotFound", (Exception,), {})("404"), False),            # GCS 404
+])
+def classification_test(exc, transient):
+    assert retry.is_transient(exc) is transient
+
+
+def default_policy_swap_test():
+    """set_default_policy swaps take effect at existing call sites at once
+    (consumers look the policy up at call time, never cache it)."""
+    old = retry.default_policy()
+    try:
+        marker = retry.RetryPolicy(max_attempts=1)
+        retry.set_default_policy(marker)
+        assert retry.default_policy() is marker
+        retry.set_default_policy(None)
+        assert retry.default_policy() is not marker
+    finally:
+        retry.set_default_policy(old)
+
+
+def gcsfs_primitives_retry_test(monkeypatch):
+    """Every GCSFS primitive retries transient client failures: a fake
+    google-cloud client that 503s the first N calls of each method succeeds
+    under the policy, and the blobs land intact."""
+    import sys
+    import types
+
+    from homebrewnlp_tpu.utils import fs
+
+    class ServiceUnavailable(Exception):  # matched by NAME, like the real one
+        pass
+
+    failures = {"n": 0}
+
+    def maybe_fail():
+        if failures["n"] > 0:
+            failures["n"] -= 1
+            raise ServiceUnavailable("503 backend error")
+
+    store = {}
+
+    class Blob:
+        def __init__(self, name):
+            self.name = name
+
+        def upload_from_string(self, data):
+            maybe_fail()
+            store[self.name] = bytes(data)
+
+        def download_as_bytes(self):
+            maybe_fail()
+            return store[self.name]
+
+        def delete(self):
+            maybe_fail()
+            store.pop(self.name, None)
+
+    class Bucket:
+        name = "bucket"
+
+        def blob(self, name):
+            return Blob(name)
+
+        def list_blobs(self, prefix=""):
+            maybe_fail()
+            return [Blob(n) for n in sorted(store) if n.startswith(prefix)]
+
+    class Client:
+        def bucket(self, name):
+            return Bucket()
+
+    storage_mod = types.ModuleType("google.cloud.storage")
+    storage_mod.Client = Client
+    cloud_mod = types.ModuleType("google.cloud")
+    cloud_mod.storage = storage_mod
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", storage_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud_mod)
+
+    sleeps = []
+    old = retry.default_policy()
+    retry.set_default_policy(retry.RetryPolicy(
+        max_attempts=4, base_delay=0.1, sleep=sleeps.append,
+        rng=random.Random(0)))
+    try:
+        gcsfs = fs.GCSFS()
+        fs.register("gs", gcsfs)
+        for op in (lambda: gcsfs._write("gs://bucket/a", b"payload"),
+                   lambda: gcsfs._read("gs://bucket/a"),
+                   lambda: gcsfs._keys("gs://bucket/"),
+                   lambda: gcsfs._delete("gs://bucket/a")):
+            failures["n"] = 2  # two 503s, then success — inside the budget
+            op()
+        assert "gs://bucket/a"[len("gs://bucket/"):] not in store
+        assert len(sleeps) == 8  # 2 retries x 4 primitives
+        # budget exhaustion: 4 failures > 4 attempts - 1 retries
+        failures["n"] = 99
+        with pytest.raises(ServiceUnavailable):
+            gcsfs._read("gs://bucket/b")
+    finally:
+        retry.set_default_policy(old)
+        fs.register("gs", fs.GCSFS)

@@ -1,0 +1,260 @@
+"""Run-level fault tolerance: preemption-safe shutdown (SIGTERM mid-train →
+emergency checkpoint → resume to target), the non-finite-loss guard, the
+Prefetcher error seam, and the fleet manager's clean-preemption exit code.
+Deterministic: signals are raised from inside the step cadence (no subprocess
+polling), divergence is forced analytically, manager sleeps are patched out."""
+import json
+import os
+import signal
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from backend import make_params
+from homebrewnlp_tpu.config import ModelParameter
+from homebrewnlp_tpu.data.inputs import Prefetcher
+from homebrewnlp_tpu.data.tfrecord import RecordWriter, encode_example
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer, TrainState
+from homebrewnlp_tpu.train import checkpoint as ckpt
+from run_manager_test import _load_run_manager
+
+
+# ---- Prefetcher error seam -------------------------------------------------
+
+def prefetcher_error_propagation_test():
+    """Satellite: a fill-thread exception must re-raise in the consumer, not
+    masquerade as dataset exhaustion (train() would exit cleanly at the
+    wrong step)."""
+
+    def gen():
+        yield 1
+        yield 2
+        raise IOError("decode failed mid-stream")
+
+    out = []
+    with pytest.raises(IOError, match="decode failed"):
+        for item in Prefetcher(gen(), depth=2):
+            out.append(item)
+    assert out == [1, 2]
+
+
+def prefetcher_sentinel_not_dropped_test():
+    """The done sentinel survives a full queue: a slow consumer must still
+    see the end of a finite dataset instead of blocking forever."""
+    import queue
+    import time
+
+    p = Prefetcher(iter(range(4)), depth=4)
+    deadline = time.time() + 10  # watchdog only; normally instant
+    while not p.q.full() and time.time() < deadline:
+        pass  # wait for the fill thread to park with the queue FULL
+    assert p.q.full()
+    out = []
+    while True:  # manual drain: queue.Empty instead of a hang on regression
+        item = p.q.get(timeout=10)
+        if item is p._done:
+            break
+        out.append(item)
+    assert out == list(range(4))
+
+
+# ---- non-finite loss guard -------------------------------------------------
+
+def nonfinite_skip_preserves_state_test():
+    """The jitted step SELECTS the pre-step state on a non-finite loss (the
+    input state is donated, so the skip must happen on-device): variables
+    and the step counter come back unchanged."""
+    params = make_params(nonfinite_loss_tolerance=3, depth=1,
+                         optimizer="learning_rate", learning_rate=0.1,
+                         weight_decay=0.0)
+    m = Model(params)
+    tr = Trainer(params, m)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    batch = {"token_x": jnp.asarray(x),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+    state = tr.init_state(batch)
+
+    # finite path first: the guard must not block normal training
+    state, metrics = tr.step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 1
+
+    poisoned = {k: jnp.full(np.shape(v), jnp.inf, jnp.float32)
+                for k, v in state.variables.items()}
+    state = TrainState(poisoned, state.opt_state, state.step)
+    new_state, metrics = tr.step(state, batch, jax.random.PRNGKey(1))
+    assert not np.isfinite(float(metrics["loss"]))
+    assert int(new_state.step) == 1  # counter not advanced
+    for k, v in new_state.variables.items():
+        # kept = the poisoned +inf inputs; an applied update would be nan
+        assert np.isinf(np.asarray(v, np.float32)).all(), k
+
+
+# ---- in-process smoke-train helpers ----------------------------------------
+
+def _write_records(tmp_path, n_files=2, tokens_per_file=2048):
+    data_dir = tmp_path / "data"
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n_files):
+        tokens = rng.integers(0, 32, tokens_per_file).astype(np.uint8)
+        with RecordWriter(str(data_dir / f"p_{i}.tfrecord")) as w:
+            w.write(encode_example({"text": tokens.tobytes()}))
+    return data_dir
+
+
+def _train_cfg(tmp_path, data_dir, **overrides):
+    cfg = {
+        "model_mode": "gpt", "use_video": False, "use_language": True,
+        "sequence_length": 16, "features_per_head": 8, "heads": 2,
+        "depth": 1, "train_batch_size": 8, "vocab_size": 32, "tpu_size": 8,
+        "block_config": [{"layer": ["norm-shift-scale-features-group",
+                                    "feed_forward-in:relu"]}],
+        "memory_reduction_strategy": "none",
+        "optimizer": "adam-learning_rate", "learning_rate": 1e-3,
+        "weight_decay": 0.0, "train_steps": 8, "interleaved_datasets": 2,
+        "use_checkpointing": True, "steps_per_checkpoint": 1000,
+        "max_checkpoints_keep": 3, "data_seed": 1337,
+        "storage_retry_base_delay": 0.0,
+        "dataset_configs": [{"path": str(data_dir / "*"), "type": "text",
+                             "weight": 1}],
+        "model_path": str(tmp_path / "run"),
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def sigterm_mid_train_resume_test(tmp_path, monkeypatch):
+    """Tentpole acceptance: SIGTERM mid-smoke-train finishes the in-flight
+    step, writes the emergency checkpoint, reports preempted; a fresh
+    train() resumes from it and reaches the target step with the run log
+    rewritten to the consumed counts.  The signal is raised from the metric
+    cadence — deterministic, no subprocess, no polling."""
+    import homebrewnlp_tpu.train.metrics as metrics_mod
+    from homebrewnlp_tpu.run import train_loop as tl
+
+    cfg = _train_cfg(tmp_path, _write_records(tmp_path))
+    orig_log = metrics_mod.MetricLogger.log
+
+    def log_then_preempt(self, step, *a, **k):
+        orig_log(self, step, *a, **k)
+        if step >= 3:
+            signal.raise_signal(signal.SIGTERM)
+
+    monkeypatch.setattr(metrics_mod.MetricLogger, "log", log_then_preempt)
+    result = tl.train(ModelParameter(cfg), log_every=1)
+    assert result["preempted"] is True
+    stopped = result["final_step"]
+    assert 0 < stopped < cfg["train_steps"]
+    # the emergency checkpoint is on disk at the stopped step
+    assert ckpt.latest_step(cfg["model_path"]) == stopped
+    # the run log was rewritten to the steps actually consumed
+    log = [json.loads(line) for line in
+           open(os.path.join(cfg["model_path"], "DataLog.log"))]
+    assert log[-1]["steps"] == result["steps"]
+
+    # resume: no preemption hook, fresh params — reaches the target
+    monkeypatch.setattr(metrics_mod.MetricLogger, "log", orig_log)
+    result2 = tl.train(ModelParameter(cfg), log_every=100)
+    assert result2["preempted"] is False
+    assert result2["final_step"] == cfg["train_steps"]
+    assert result2["steps"] == cfg["train_steps"] - stopped
+    log = [json.loads(line) for line in
+           open(os.path.join(cfg["model_path"], "DataLog.log"))]
+    assert len(log) == 2 and log[-1]["steps"] == result2["steps"]
+
+
+def nonfinite_abort_after_tolerance_test(tmp_path):
+    """A diverged run (lr so large the z-loss overflows fp32) skips the
+    poisoned updates, then aborts with NonFiniteLossError after N
+    consecutive non-finite losses — leaving the emergency checkpoint at the
+    LAST GOOD step."""
+    from homebrewnlp_tpu.run import train_loop as tl
+
+    cfg = _train_cfg(tmp_path, _write_records(tmp_path),
+                     optimizer="learning_rate", learning_rate=1e30,
+                     weight_standardisation=False,
+                     weight_centralisation=False,
+                     nonfinite_loss_tolerance=2, train_steps=20)
+    with pytest.raises(tl.NonFiniteLossError, match="consecutive"):
+        tl.train(ModelParameter(cfg), log_every=100)
+    # the update at the diverged steps was skipped: the checkpoint holds the
+    # last good state (step 1 — the first update is what diverged)
+    assert ckpt.latest_step(cfg["model_path"]) == 1
+    restored = ckpt.restore_latest_valid(cfg["model_path"])
+    assert restored is not None and restored[2] == 1
+    for arr in restored[0].values():
+        assert np.isfinite(np.asarray(arr, np.float32)).all()
+
+
+def all_corrupt_checkpoints_refuse_resume_test(tmp_path):
+    """When checkpoints exist but NONE restores cleanly, train() must fail
+    loudly instead of silently training from random init over the corpse
+    (replaying the data log and pruning the old checkpoints)."""
+    from homebrewnlp_tpu.run import train_loop as tl
+
+    cfg = _train_cfg(tmp_path, _write_records(tmp_path), train_steps=2)
+    tl.train(ModelParameter(cfg), log_every=100)
+    run = cfg["model_path"]
+    for d in os.listdir(run):
+        if not d.startswith("ckpt_"):
+            continue
+        for f in os.listdir(os.path.join(run, d)):
+            if f.startswith("arr_"):
+                p = os.path.join(run, d, f)
+                blob = bytearray(open(p, "rb").read())
+                blob[0] ^= 0xFF
+                open(p, "wb").write(bytes(blob))
+    with pytest.raises(ckpt.CheckpointError, match="none restored"):
+        tl.train(ModelParameter(cfg), log_every=100)
+
+
+def train_mode_preempted_exit_code_test(monkeypatch):
+    """modes.train_mode maps the preempted result onto the distinct exit
+    code (143) that scripts/run_manager.py recognises."""
+    from homebrewnlp_tpu.run import modes
+
+    monkeypatch.setattr(modes, "train_loop",
+                        lambda p: {"preempted": True, "steps": 3})
+    assert modes.train_mode(None, None) == modes.PREEMPTED_EXIT_CODE
+    monkeypatch.setattr(modes, "train_loop",
+                        lambda p: {"preempted": False, "steps": 3})
+    assert modes.train_mode(None, None) == 0
+
+
+# ---- fleet manager: clean preemption is a relaunch, not a finish -----------
+
+def manager_relaunches_on_preempted_exit_code_test(tmp_path, monkeypatch):
+    """Satellite: rc=143 (clean preemption after the emergency checkpoint)
+    relaunches the run WITHOUT consuming the crash budget — max_restarts=1
+    would abandon the run if preemptions counted — and a later rc=0 still
+    finishes it."""
+    rm = _load_run_manager()
+    monkeypatch.setattr(rm.time, "sleep", lambda *_: None)
+    monkeypatch.setattr(rm.random, "randint", lambda *_: 0)
+
+    d = str(tmp_path)
+    # two clean preemptions, then success: with max_restarts=1 the run only
+    # completes if preempted relaunches bypass the restart counter
+    run_cmd = (f"n=$(cat {d}/n 2>/dev/null || echo 0); "
+               f"echo $((n+1)) > {d}/n; "
+               f"if [ \"$n\" -ge 2 ]; then exit 0; "
+               f"else exit {rm.PREEMPTED_RC}; fi")
+    args = types.SimpleNamespace(
+        run_command=run_cmd, model_path=d, create_cmd="", health_cmd="",
+        delete_cmd="", poll_interval=0, poll_jitter=0, stall_timeout=0,
+        max_restarts=1)
+    rm.Manager(args).run()
+
+    log = open(os.path.join(d, "run.log")).read()
+    assert log.count("clean preemption") == 2, log
+    assert "max restarts exceeded" not in log, log
+    assert "restarting (#" not in log, log  # crash budget untouched
+    assert "training exited rc=0; done" in log, log
+    assert open(f"{d}/n").read().strip() == "3"
