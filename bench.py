@@ -219,16 +219,24 @@ def main() -> int:
     # companion succeeds and this one when it dies
     print(json.dumps(out), flush=True)
 
-    def companion(label: str, prefix: str, run_fn, keys=()):
+    def companion(label: str, prefix: str, run_fn, keys=(),
+                  value_key: str = "value",
+                  value_dst: str = "_tokens_per_sec_chip"):
         """Run one companion bench, merge its result under ``prefix`` onto
         the headline line, re-print the enriched line.  Returns False when
-        the companion failed (the printed line so far still stands)."""
+        the companion failed (the printed line so far still stands).  A
+        companion returning a dict WITHOUT ``value_key`` (e.g. an error
+        dict) is reported as a failed companion instead of aborting the
+        remaining companions with a KeyError."""
         try:
             res = run_fn()
+            if not isinstance(res, dict) or value_key not in res:
+                raise KeyError(f"companion result has no {value_key!r}: "
+                               f"{str(res)[:200]}")
         except Exception as exc:
             print(f"{label} companion bench failed: {exc}", file=sys.stderr)
             return False
-        out[f"{prefix}_tokens_per_sec_chip"] = res["value"]
+        out[prefix + value_dst] = res[value_key]
         for key, dst in (("metric", f"{prefix}_metric"),
                          ("mfu", f"{prefix}_mfu"),
                          ("mfu_causal", f"{prefix}_mfu_causal"),
@@ -245,10 +253,9 @@ def main() -> int:
     state = trainer = batches = None  # free HBM before the 16k compile
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
     import bench_long_context as lc
-    if not companion("long-context", "long_context", lc.run):
-        return 0
+    lc_ok = companion("long-context", "long_context", lc.run)
 
-    if jax.default_backend() != "cpu":
+    if lc_ok and jax.default_backend() != "cpu":
         # 32k companion (TPU only — the CPU fallback would shrink to the
         # same shape as the 16k companion): the longest context one chip
         # trains; the fused backward admits its 4.3GB dq-partial buffer
@@ -261,8 +268,23 @@ def main() -> int:
             import bench_moe
             return bench_moe.run()
         companion("moe", "moe", run_moe,
-                  keys=(("expert_utilization_min",
-                         "moe_expert_utilization_min"),))
+                  keys=(("expert_utilization_min_at_init",
+                         "moe_expert_utilization_min_at_init"),))
+
+    # decode-latency companion (every backend; shapes shrink on CPU): the
+    # sequence-scaling probe as a TRACKED metric — ms/token at 8k/16k/32k
+    # with bf16 and int8 caches, plus the 32k/8k per-token-vs-byte ratio
+    # that caught the cache-carry copy bug (BASELINE.md round 5)
+    def run_decode():
+        import bench_decode
+        return bench_decode.run()
+    companion("decode", "decode", run_decode,
+              keys=(("rows", "decode_rows"),
+                    ("scaling_ratio_large_small",
+                     "decode_scaling_ratio_large_small"),
+                    ("byte_ratio_large_small",
+                     "decode_byte_ratio_large_small")),
+              value_dst="_ms_per_token")
     return 0
 
 

@@ -109,6 +109,25 @@ class ModelParameter:
         # storage dtype for decode-time KV caches (None = calculation dtype);
         # the cache dominates decode HBM at wide batch — see BASELINE.md
         self.decode_cache_dtype = None
+        # decode loop structure (infer/sampler.py).  "fused": the whole
+        # generation is ONE jitted lax.while_loop (lowest dispatch overhead;
+        # the cache carry's in-place aliasing is at XLA's discretion and
+        # measurably breaks at multi-GB caches — BASELINE.md round 5: 60.1
+        # ms/token at 32k vs the ~8 ms read bound).  "stepped": generation is
+        # a host loop over a jitted CHUNK of decode steps whose carry
+        # (token_x, caches, rng, position) is DONATED — input_output_aliases
+        # then pins every cache update in place, a property asserted on the
+        # compiled HLO (infer/hlo_check.py).  "auto": stepped when the cache
+        # pytree exceeds decode_stepped_min_cache_gb, fused below it.
+        self.decode_loop = "auto"
+        # tokens per jitted chunk dispatch on the stepped path; amortises
+        # per-dispatch host latency (at ~0.1 ms dispatch and >= 1 ms/token
+        # big-cache steps even 16 is < 1% overhead)
+        self.decode_chunk_tokens = 64
+        # "auto" switches to the stepped loop at this cache size: below it
+        # the fused while_loop aliases fine (measured at 0.5 GB flagship
+        # scale) and avoids per-chunk dispatch entirely
+        self.decode_stepped_min_cache_gb = 1.0
         self.optimizer_slice_dtype = "float32"
         self.optimizer_calculation_dtype = "float32"
         self.learning_rate_config: typing.Dict[str, typing.Any] = {}
@@ -302,6 +321,17 @@ class ModelParameter:
         if self.sampling_repetition_penalty <= 0:
             raise ValueError("sampling_repetition_penalty must be > 0, got "
                              f"{self.sampling_repetition_penalty}")
+        # tri-state like stash_attention_outputs: any other string would
+        # silently route serving through an unintended decode loop
+        if self.decode_loop not in ("auto", "fused", "stepped"):
+            raise ValueError("decode_loop must be \"auto\", \"fused\" or "
+                             f"\"stepped\", got {self.decode_loop!r}")
+        if self.decode_chunk_tokens < 1:
+            raise ValueError("decode_chunk_tokens must be >= 1, got "
+                             f"{self.decode_chunk_tokens}")
+        if self.decode_stepped_min_cache_gb < 0:
+            raise ValueError("decode_stepped_min_cache_gb must be >= 0, got "
+                             f"{self.decode_stepped_min_cache_gb}")
         # tri-state: any other string would fall through bool("...") == True
         # and silently force-enable stashing ("false" enabling a feature)
         if self.stash_attention_outputs not in (True, False, "auto"):

@@ -92,6 +92,49 @@ class InterfaceWrapper:
             self._width_models[width] = (p, m)
         return self._width_models[width]
 
+    def decode_path(self, width: typing.Optional[int] = None) -> dict:
+        """Which decode loop serves ``width``-wide batches and why — ops
+        surface for the REST ``/health`` endpoint.  The stepped loop's
+        in-place cache carry is what makes big-context serving viable
+        (docs/PERFORMANCE.md 'Big-cache decode'), so whether a deployment
+        actually routes through it should be observable, not inferred."""
+        from .sampler import _use_stepped_loop, decode_cache_bytes
+        p = self.params
+        # default to the deployment's MAX batched-serving width (the device
+        # loop drains up to serve_batch_size requests into one decode):
+        # cache bytes scale with width, so reporting the training batch
+        # width would misstate which loop real traffic decodes through
+        serve_max = max(1, int(getattr(p, "serve_batch_size", 1) or 1))
+        width = int(width or serve_max)
+        # clamp to widths the serving path can actually run, then round up
+        # to its power-of-two padding — /health is client-reachable, so an
+        # arbitrary width must not grow the per-width model cache unbounded
+        # (each distinct width builds and caches a plan view) or stall the
+        # device loop behind a giant eval_shape trace
+        width = min(max(width, 1), max(serve_max, p.train_batch_size))
+        pow2 = 1
+        while pow2 < width:
+            pow2 *= 2
+        width = pow2
+        _, model_w = self._model_for_width(width)
+        seq = p.sequence_length // p.token_patch_size
+        token_shape = np.zeros((width, seq, p.token_patch_size), np.int32)
+        try:
+            cache_bytes = decode_cache_bytes(model_w, self.variables,
+                                             token_shape)
+            stepped = _use_stepped_loop(model_w, self.variables, token_shape)
+        except NotImplementedError:
+            # a layer without a streaming form serves via the full-forward
+            # fallback; there is no cache to report
+            return {"loop": "full_forward_fallback", "batch_width": width}
+        return {"loop": "stepped" if stepped else "fused",
+                "configured": p.decode_loop,
+                "batch_width": width,
+                "cache_gb": round(cache_bytes / 1024 ** 3, 3),
+                "chunk_tokens": int(p.decode_chunk_tokens),
+                "cache_dtype": str(p.decode_cache_dtype or
+                                   p.calculation_dtype)}
+
     def complete_tokens(self, tokens: np.ndarray, temperature: float = 0.0,
                         response_len: typing.Optional[int] = None,
                         seed: int = 0, top_k: int = None,

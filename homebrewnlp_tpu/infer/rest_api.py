@@ -118,8 +118,21 @@ def _handlers(interface: InterfaceWrapper):
     def decode(body: dict) -> dict:
         return {"prompt": interface.tokenizer.decode(body.get("tokens", []))}
 
+    def health(body: dict) -> dict:
+        """Ops surface: which decode loop serves this deployment (the
+        stepped in-place cache carry vs the fused while_loop — the config's
+        ``decode_loop`` knob resolved against the actual cache size) plus
+        the decode-call counter.  ``width`` selects a batched-serving
+        width; default is the deployment's serve width."""
+        p = interface.params
+        width = int(body.get("width") or 0) or None
+        return {"status": "ok",
+                "decode_calls": interface.decode_calls,
+                "serve_batch_size": int(getattr(p, "serve_batch_size", 1)),
+                "decode_path": interface.decode_path(width)}
+
     return {"/completion": completion, "/token_completion": token_completion,
-            "/encode": encode, "/decode": decode}
+            "/encode": encode, "/decode": decode, "/health": health}
 
 
 def _run_http(port: int, paths: typing.List[str],

@@ -16,6 +16,7 @@ import typing
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import ModelParameter
 from ..model import Model
@@ -140,12 +141,13 @@ def decode_cache_shapes(model: Model, variables, token_x) -> dict:
     via eval_shape — no device compute; callable at trace time).
 
     When the decode scan engages, the caches are DEPTH-STACKED
-    (``model.blocks.stack_decode_caches``) so the sampler's while_loop carry
-    feeds the scan as xs directly — the per-token flat<->stacked restack was
-    hundreds of MB of HBM traffic per token at flagship size
-    (docs/PERFORMANCE.md 'Decoding').  Falls back to the flat layout when a
-    stacked carry wouldn't round-trip (e.g. non-homogeneous stacks where the
-    decode body unrolls and resolves flat names)."""
+    (``model.blocks.stack_decode_caches``) so the sampler's loop carry feeds
+    the scan directly (read as invariants, row updates as ys) — the
+    per-token flat<->stacked restack was hundreds of MB of HBM traffic per
+    token at flagship size (docs/PERFORMANCE.md 'Decoding').  Falls back to
+    the flat layout when a stacked carry wouldn't round-trip (e.g.
+    non-homogeneous stacks where the decode body unrolls and resolves flat
+    names)."""
     from ..model import blocks as blocks_mod
 
     tok0 = token_x[:, :1]
@@ -212,6 +214,80 @@ def _match_cache_layout(model: Model, produced: dict, expected: dict) -> dict:
     return produced
 
 
+def _kv_prep(model: Model, token_x, ipb, logits_filter: bool):
+    """Pre-loop state shared by the fused and stepped KV paths: the
+    full-sampler parity write at position 0, and the repetition-penalty
+    ``seen`` counts seeded from each row's prompt region.
+
+    Factored out so the stepped path (host loop over donated chunks) and the
+    fused path (one while_loop) start from bit-identical state — greedy
+    parity between the two is a tested invariant (tests/decode_inplace_test)."""
+    # full-sampler parity: its first iteration at position 0 writes 0
+    # (the roll fills index 0 with zeros)
+    zero_first = (ipb == 0)[:, None]
+    token_x = token_x.at[:, 0].set(
+        jnp.where(zero_first, jnp.zeros_like(token_x[:, 0]), token_x[:, 0]))
+    seen0 = None
+    if logits_filter:
+        # token-occurrence counts for the repetition penalty, seeded
+        # from each row's prompt region and scatter-updated per step.
+        # ipb == 0 rows still hold one context token: index 0 — the
+        # zero_first write just above (which is why this runs AFTER it);
+        # the full sampler counts it via cmask index < position from
+        # position 1, so seed it here too
+        batch = token_x.shape[0]
+        vocab = model.params.vocab_size
+        rows = jnp.arange(batch)[:, None, None]
+        pmask = (jnp.arange(token_x.shape[1])[None, :, None]
+                 < jnp.maximum(ipb, 1)[:, None, None]).astype(jnp.float32)
+        seen0 = jnp.zeros((batch, vocab), jnp.float32
+                          ).at[rows, token_x].add(pmask)
+    return token_x, seen0
+
+
+def _kv_body(model: Model, mesh, logits_filter: bool, variables, ipb, tb,
+             filt):
+    """One KV-cached decode step ``state -> state`` (state = (q, token_x,
+    caches, key[, seen])).  The single definition serves the fused
+    while_loop AND the donated stepped chunks — both walk the identical
+    body, so their greedy outputs match exactly."""
+    batch = ipb.shape[0]
+    rows = jnp.arange(batch)[:, None, None]
+    if logits_filter:
+        kb, pb, rb = filt
+
+    def body_fn(state):
+        if logits_filter:
+            q, token_x, caches, key, seen = state
+        else:
+            q, token_x, caches, key = state
+        cur = jax.lax.dynamic_slice_in_dim(token_x, q, 1, axis=1)
+        logits, caches = model.apply_decode(variables, cur, q, caches,
+                                            mesh=mesh)
+        logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
+        if logits_filter:
+            logits = _repetition_penalty(logits, seen, rb)
+            logits = _filter_logits(logits, tb, kb, pb)
+        key, sub = jax.random.split(key)
+        u = jax.random.uniform(sub, logits.shape, jnp.float32,
+                               minval=1e-9, maxval=1.0)
+        logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
+        nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
+        old = jax.lax.dynamic_slice_in_dim(token_x, q + 1, 1, axis=1)
+        new = jnp.where(q + 1 >= ipb[:, None, None], nxt, old)
+        token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
+                                                      axis=1)
+        if logits_filter:
+            # count the newly WRITTEN token (prompt rows not yet at
+            # their boundary keep `old`, already counted by seen0)
+            seen = seen.at[rows, new].add(
+                (q + 1 >= ipb).astype(jnp.float32)[:, None, None])
+            return q + 1, token_x, caches, key, seen
+        return q + 1, token_x, caches, key
+
+    return body_fn
+
+
 def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
                     logits_filter: bool = False) -> typing.Callable:
     """KV-cached sampler: O(1) compute per token via ``Model.apply_decode``.
@@ -262,24 +338,7 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
         # iterations at position >= seq are no-ops in the full sampler (its
         # one-hot write misses); clamp instead of letting the update clamp
         end_iterations = jnp.minimum(end_iterations, token_x.shape[1])
-        # full-sampler parity: its first iteration at position 0 writes 0
-        # (the roll fills index 0 with zeros)
-        zero_first = (ipb == 0)[:, None]
-        token_x = token_x.at[:, 0].set(
-            jnp.where(zero_first, jnp.zeros_like(token_x[:, 0]), token_x[:, 0]))
-        if logits_filter:
-            # token-occurrence counts for the repetition penalty, seeded
-            # from each row's prompt region and scatter-updated per step.
-            # ipb == 0 rows still hold one context token: index 0 — the
-            # zero_first write just above (which is why this runs AFTER it);
-            # the full sampler counts it via cmask index < position from
-            # position 1, so seed it here too
-            vocab = model.params.vocab_size
-            rows = jnp.arange(batch)[:, None, None]
-            pmask = (jnp.arange(token_x.shape[1])[None, :, None]
-                     < jnp.maximum(ipb, 1)[:, None, None]).astype(jnp.float32)
-            seen0 = jnp.zeros((batch, vocab), jnp.float32
-                              ).at[rows, token_x].add(pmask)
+        token_x, seen0 = _kv_prep(model, token_x, ipb, logits_filter)
 
         q_start = jnp.asarray(0, jnp.int32)
         if not caches:
@@ -308,34 +367,8 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
             q, *_ = state
             return q < end_iterations - 1
 
-        def body_fn(state):
-            if logits_filter:
-                q, token_x, caches, key, seen = state
-            else:
-                q, token_x, caches, key = state
-            cur = jax.lax.dynamic_slice_in_dim(token_x, q, 1, axis=1)
-            logits, caches = model.apply_decode(variables, cur, q, caches,
-                                                mesh=mesh)
-            logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
-            if logits_filter:
-                logits = _repetition_penalty(logits, seen, rb)
-                logits = _filter_logits(logits, tb, kb, pb)
-            key, sub = jax.random.split(key)
-            u = jax.random.uniform(sub, logits.shape, jnp.float32,
-                                   minval=1e-9, maxval=1.0)
-            logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
-            nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
-            old = jax.lax.dynamic_slice_in_dim(token_x, q + 1, 1, axis=1)
-            new = jnp.where(q + 1 >= ipb[:, None, None], nxt, old)
-            token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
-                                                          axis=1)
-            if logits_filter:
-                # count the newly WRITTEN token (prompt rows not yet at
-                # their boundary keep `old`, already counted by seen0)
-                seen = seen.at[rows, new].add(
-                    (q + 1 >= ipb).astype(jnp.float32)[:, None, None])
-                return q + 1, token_x, caches, key, seen
-            return q + 1, token_x, caches, key
+        body_fn = _kv_body(model, mesh, logits_filter, variables, ipb, tb,
+                           (kb, pb, rb) if logits_filter else None)
 
         if logits_filter:
             _, token_x, _, _, _ = jax.lax.while_loop(
@@ -346,6 +379,134 @@ def make_kv_sampler(model: Model, mesh=None, prefill: bool = False,
         return token_x
 
     return sample
+
+
+def make_kv_step(model: Model, mesh=None, logits_filter: bool = False,
+                 init_caches: bool = False) -> typing.Callable:
+    """One CHUNK of KV-cached decode steps with a donatable carry.
+
+    ``step(variables, ipb, tb, end_iterations, q_hi, fargs, carry)`` advances
+    ``carry = (q, token_x, caches, key[, seen])`` until ``q`` reaches
+    ``min(q_hi, end_iterations - 1)`` and returns the updated carry.  Jitted
+    with the carry DONATED (``_jit_sampler`` kinds ``"kv_step"``), every
+    cache buffer is pinned to an input_output_alias: the XLA while carry
+    chains parameter -> loop state -> result, so the per-token cache scatter
+    provably updates in place instead of copying the multi-GB cache — the
+    property the fused single-while_loop sampler loses at large cache sizes
+    (BASELINE.md round 5: 60.1 ms/token at 32k vs the ~8 ms read bound) and
+    the one `infer/hlo_check.py` asserts on the compiled module.
+
+    The body is ``_kv_body`` — the same step the fused sampler runs — so
+    greedy outputs are bit-identical between the two loop structures.
+
+    ``init_caches=True`` builds the FIRST chunk's variant: the carry omits
+    the caches and the zeros are built inside this trace — under a serving
+    mesh the first decode step's ``_constrain_cache`` then pins their
+    sharding (heads over 'model') within the same program, where a separate
+    zero-init jit would hand multi-GB replicated buffers across the jit
+    boundary.  Subsequent chunks use the plain donated step.
+    """
+    def step(variables, ipb, tb, end_iterations, q_hi, fargs, carry):
+        if init_caches:
+            q, token_x, *rest = carry
+            caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
+                      decode_cache_shapes(model, variables,
+                                          token_x).items()}
+            carry = (q, token_x, caches, *rest)
+        end_iterations = jnp.minimum(end_iterations, carry[1].shape[1])
+        body_fn = _kv_body(model, mesh, logits_filter, variables, ipb, tb,
+                           fargs if logits_filter else None)
+
+        def cond_fn(state):
+            return (state[0] < end_iterations - 1) & (state[0] < q_hi)
+
+        return jax.lax.while_loop(cond_fn, body_fn, carry)
+
+    return step
+
+
+def decode_cache_bytes(model: Model, variables, token_x) -> int:
+    """Total bytes of the decode-cache pytree (abstract — no allocation);
+    drives the ``decode_loop: "auto"`` fused-vs-stepped routing."""
+    cache = model.__dict__.setdefault("_decode_cache_bytes", {})
+    # the cache dtype is part of the key: params mutated on a live model
+    # (the int8 A/B pattern) must not serve a stale byte count
+    key = (tuple(token_x.shape), str(model.params.decode_cache_dtype))
+    if key not in cache:
+        shapes = decode_cache_shapes(model, variables, token_x)
+        cache[key] = sum(int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+                         for v in shapes.values())
+    return cache[key]
+
+
+def _use_stepped_loop(model: Model, variables, token_x) -> bool:
+    p = model.params
+    mode = getattr(p, "decode_loop", "auto")
+    if mode == "fused":
+        return False
+    if mode == "stepped":
+        return True
+    threshold = float(p.decode_stepped_min_cache_gb) * 1024 ** 3
+    return decode_cache_bytes(model, variables, token_x) >= threshold
+
+
+def _sample_kv_stepped(model: Model, variables, token_x, initial_pos,
+                       temperature, end_iterations, key, mesh=None,
+                       prefill: bool = False, fargs=()):
+    """Host-side driver for the stepped decode loop: prefill (or zero-init)
+    the caches in their own jitted call, then walk the token loop as
+    ``ceil(steps / decode_chunk_tokens)`` dispatches of the DONATED chunk
+    step.  Per-dispatch latency amortises over the chunk; the donated carry
+    keeps one live copy of the caches across the whole generation."""
+    p = model.params
+    filt = bool(fargs)
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    ipb_host = np.broadcast_to(np.asarray(initial_pos, np.int32), (batch,))
+    ipb = jnp.asarray(ipb_host)
+    tb = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (batch,))
+    if filt:
+        top_k, top_p, rep = fargs
+        fargs = (jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (batch,)),
+                 jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (batch,)),
+                 jnp.broadcast_to(jnp.asarray(rep, jnp.float32), (batch,)))
+    end = int(min(int(np.asarray(end_iterations)), seq))
+    suffix = "+filter" if filt else ""
+
+    token_x, seen0 = _jit_sampler(model, mesh, "kv_prep" + suffix)(
+        token_x, ipb)
+    step = _jit_sampler(model, mesh, "kv_step" + suffix)
+    chunk = max(1, int(getattr(p, "decode_chunk_tokens", 64)))
+    end_dev = jnp.asarray(end, jnp.int32)
+    if prefill:
+        # one full forward captures the caches decode steps 0..n0-1 would
+        # write (make_kv_sampler documents the q/ipb arithmetic); runs on
+        # the PREPPED token_x so the captured rows match the fused path
+        q0 = max(int(ipb_host.min()) - 1, 0)
+        caches = _jit_sampler(model, mesh, "kv_prefill_caches")(
+            variables, token_x, jnp.asarray(q0, jnp.int32))
+        carry = (jnp.asarray(q0, jnp.int32), token_x, caches, key)
+        if filt:
+            carry = carry + (seen0,)
+        q = q0
+    else:
+        # the first chunk builds the zero caches INSIDE its own trace (the
+        # "kv_step_init" kind) so a serving mesh constrains their sharding
+        # in-program; it returns the full carry for the donated steady loop
+        q0, q = 0, min(chunk, end - 1)
+        if q <= 0:
+            return token_x  # nothing to generate
+        carry0 = (jnp.asarray(q0, jnp.int32), token_x, key)
+        if filt:
+            carry0 = carry0 + (seen0,)
+        carry = _jit_sampler(model, mesh, "kv_step_init" + suffix)(
+            variables, ipb, tb, end_dev, jnp.asarray(q, jnp.int32), fargs,
+            carry0)
+    while q < end - 1:
+        q_hi = min(q + chunk, end - 1)
+        carry = step(variables, ipb, tb, end_dev,
+                     jnp.asarray(q_hi, jnp.int32), fargs, carry)
+        q = q_hi
+    return carry[1]
 
 
 def _jit_sampler(model: Model, mesh, kind: str):
@@ -362,13 +523,34 @@ def _jit_sampler(model: Model, mesh, kind: str):
         filt = kind.endswith("+filter")
         base = kind[:-len("+filter")] if filt else kind
         if base == "kv":
-            fn = make_kv_sampler(model, mesh=mesh, logits_filter=filt)
+            fn = jax.jit(make_kv_sampler(model, mesh=mesh, logits_filter=filt))
         elif base == "kv_prefill":
-            fn = make_kv_sampler(model, mesh=mesh, prefill=True,
-                                 logits_filter=filt)
+            fn = jax.jit(make_kv_sampler(model, mesh=mesh, prefill=True,
+                                         logits_filter=filt))
+        elif base == "kv_step":
+            # the stepped path's chunk: carry (argument 6) DONATED so XLA
+            # aliases every cache buffer input->output — the in-place
+            # property infer/hlo_check.py asserts on the compiled module
+            fn = jax.jit(make_kv_step(model, mesh=mesh, logits_filter=filt),
+                         donate_argnums=(6,))
+        elif base == "kv_step_init":
+            # first chunk: zero caches built in-trace (mesh-constrained by
+            # the first decode step); cacheless carry still donated
+            fn = jax.jit(make_kv_step(model, mesh=mesh, logits_filter=filt,
+                                      init_caches=True),
+                         donate_argnums=(6,))
+        elif base == "kv_prep":
+            fn = jax.jit(lambda t, ipb: _kv_prep(model, t, ipb, filt))
+        elif base == "kv_prefill_caches":
+            def _prefill_caches(variables, token_x, n0):
+                produced = model.apply_prefill(variables, token_x, n0,
+                                               mesh=mesh)
+                expected = decode_cache_shapes(model, variables, token_x)
+                return _match_cache_layout(model, produced, expected)
+            fn = jax.jit(_prefill_caches)
         else:
-            fn = make_sampler(model, mesh=mesh, logits_filter=filt)
-        cache[key] = jax.jit(fn)
+            fn = jax.jit(make_sampler(model, mesh=mesh, logits_filter=filt))
+        cache[key] = fn
     return cache[key]
 
 
@@ -438,7 +620,20 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
             # of walking the prompt one decode step per token (O(1) model
             # calls to first generated token); initial_pos <= 1 has nothing
             # to prefill
-            kind = "kv_prefill" if int(np.min(initial_pos)) > 1 else "kv"
+            prefill = int(np.min(initial_pos)) > 1
+            if _use_stepped_loop(model, variables, tokens_in):
+                # big caches: host loop over donated chunk steps — the
+                # cache carry aliases in place (decode_loop config knob;
+                # docs/PERFORMANCE.md 'Big-cache decode')
+                out = _sample_kv_stepped(
+                    model, variables, tokens_in,
+                    jnp.asarray(initial_pos, jnp.int32),
+                    jnp.asarray(temperature, jnp.float32),
+                    int(np.asarray(end_iterations)),
+                    jax.random.PRNGKey(seed), mesh=mesh, prefill=prefill,
+                    fargs=fargs)
+                return np.asarray(out)
+            kind = "kv_prefill" if prefill else "kv"
             fn = _jit_sampler(model, mesh, kind + "+filter" if filt else kind)
             out = fn(variables, tokens_in,
                      jnp.asarray(initial_pos, jnp.int32),

@@ -640,8 +640,9 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
     token at depth 32 (measured 207 ms/token vs 4 ms at depth 2 — pure
     dispatch overhead); scanning bounds the program to one iteration.  KV
     caches are name-keyed per block.  Preferred layout: the sampler carries
-    them depth-STACKED (``stack_decode_caches``) so they feed the scan as xs
-    and the updates return as ys with ZERO per-token restacking.  A flat
+    them depth-STACKED (``stack_decode_caches``); the scan reads them as
+    loop invariants and returns row-sized updates as ys (see the layout
+    comment at the step body) with ZERO per-token restacking.  A flat
     carry still works (stacked on entry, unstacked on exit) for callers that
     never adopted the stacked layout.  Runs only when the cache dict is
     complete and depth-homogeneous (the discovery pass with empty caches
@@ -681,16 +682,26 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
 
     alpha = params.momentumnet_alpha
 
-    # the depth-stacked caches ride the scan CARRY (slice out iteration
-    # ``it``, dynamic-update-slice the result back) rather than xs/ys: the
-    # xs->ys form kept TWO full copies of every KV buffer live during the
-    # scan — the extra copy is what pushed flagship batch-32 decode out of
-    # HBM — while a carried buffer is aliased in place by XLA's loop
-    # optimizer.
+    # The depth-stacked caches do NOT ride the scan carry: a buffer carried
+    # through the INNER while loop defeats XLA's copy elision for the OUTER
+    # token loop — the compiled module copies every cache twice per token at
+    # the nested-loop boundary (the big-cache decode bug: 60.1 ms/token at
+    # 32k vs the ~8 ms read bound, BASELINE.md round 5; reproduced in
+    # compiled HLO by tests/decode_inplace_test.py).  Instead the scan READS
+    # the stacked buffers as loop invariants (slice per depth) and emits the
+    # per-depth updates as ys — row-sized for the KV scatter sites
+    # (DecodeState.row_updates), full-block for the small recurrence caches
+    # (cumsum totals, conv windows) — and ONE dynamic_update_slice per cache
+    # after the scan applies all depth rows at the token position.  The
+    # outer-loop carry then sees a read (inside the scan) followed by a
+    # single row-granular write: exactly the pattern the aliaser keeps in
+    # place.
+    row_axis: typing.Dict[str, int] = {}  # filled during the scan trace
+
     def step(carry, sl_params):
-        *streams, it, caches = carry
+        *streams, it = carry
         sl_caches = {k: jax.lax.dynamic_index_in_dim(v, it, 0, keepdims=False)
-                     for k, v in caches.items()}
+                     for k, v in stacked_caches.items()}
         sub = decode_mod.DecodeState(state.pos, state.seq_len, state.seq_name,
                                      sl_caches,
                                      cache_dtype=state.cache_dtype,
@@ -704,8 +715,7 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
                                           tuple(streams), it=it)
         finally:
             ctx.decode = saved_decode
-        new_caches = dict(caches)
-        for rel, arr in sub.out.items():
+        for rel in sub.out:
             # the discovery pass defines every cache name before the scan
             # runs; a cache born lazily inside the scan would be silently
             # dropped from the carry (corrupting decode), so fail loudly
@@ -713,23 +723,40 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
                 f"decode cache {rel!r} created inside the scan body; it is "
                 f"not part of the sampler carry — the discovery-pass "
                 f"invariant is violated")
-            if arr is not sl_caches[rel]:  # untouched caches: no copy-back
-                new_caches[rel] = jax.lax.dynamic_update_slice_in_dim(
-                    caches[rel], arr[None].astype(caches[rel].dtype), it, 0)
-        return (*streams, it + 1, new_caches), None
+        ys = {}
+        for rel in rel_cache_names:
+            arr = sub.out.get(rel, sl_caches[rel])
+            upd = sub.row_updates.get(rel)
+            if upd is not None:
+                row, axis = upd
+                row_axis[rel] = axis
+                ys[rel] = row.astype(stacked_caches[rel].dtype)
+            else:
+                ys[rel] = arr.astype(stacked_caches[rel].dtype)
+        return (*streams, it + 1), ys
 
-    carry0 = ((src, src, jnp.int32(0), stacked_caches)
+    carry0 = ((src, src, jnp.int32(0))
               if strategy in ("revnet", "momentum")
-              else (src, jnp.int32(0), stacked_caches))
-    carry, _ = jax.lax.scan(step, carry0, stacked_params)
-    *streams, _, final_caches = carry
-    for rel, arr in final_caches.items():
+              else (src, jnp.int32(0)))
+    carry, ys = jax.lax.scan(step, carry0, stacked_params)
+    *streams, _ = carry
+    for rel, arr in ys.items():
+        axis = row_axis.get(rel)
+        if axis is None:
+            # small recurrence caches: the stacked ys IS the new buffer
+            new = arr
+        else:
+            # all depth rows land in one scatter at the token position
+            starts = [jnp.int32(0)] * arr.ndim
+            starts[axis + 1] = state.pos
+            new = jax.lax.dynamic_update_slice(stacked_caches[rel], arr,
+                                               tuple(starts))
         if stacked_in:
             # the sampler carries caches depth-stacked: write back verbatim
-            state.out[STACKED_CACHE_PREFIX + rel] = arr
+            state.out[STACKED_CACHE_PREFIX + rel] = new
         else:
             state.out.update(unstack_decode_caches(
-                params, {STACKED_CACHE_PREFIX + rel: arr}))
+                params, {STACKED_CACHE_PREFIX + rel: new}))
     return sum(streams[1:], streams[0])
 
 

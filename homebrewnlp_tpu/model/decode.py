@@ -56,6 +56,14 @@ class DecodeState:
         # splits cache HBM 1/tp per device instead of replicating it
         self.model_params = model_params
         self.out: typing.Dict[str, jax.Array] = dict(caches)
+        # cache name -> (row, axis): the length-1 slice a step scattered
+        # into the full buffer at ``pos``, in the STORED dtype.  ``out``
+        # keeps the full updated buffer (what a flat carry consumes); a
+        # depth-stacked scan carry can instead re-apply just the row into
+        # its stacked buffer (model/blocks.py _try_decode_scan), turning the
+        # per-token copy-back from a full-block write into a row write —
+        # the big-cache decode fix's write half (docs/PERFORMANCE.md)
+        self.row_updates: typing.Dict[str, typing.Tuple[jax.Array, int]] = {}
 
 
 class PrefillState:
@@ -213,6 +221,8 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
                                 full_dims[:-1] + [Dim("_kv_scale", 1)])
         state.out[name] = buf
         state.out[sname] = sbuf
+        state.row_updates[name] = (q, axis)
+        state.row_updates[sname] = (scale, axis)
         deq = (buf.astype(jnp.float32) * sbuf).astype(x.dtype)
         return nt(deq, full_dims)
     buf = _cache(name, shape, store_dtype)
@@ -220,6 +230,7 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
         buf, x.data.astype(store_dtype), state.pos, axis)
     buf = _constrain_cache(state, buf, full_dims)
     state.out[name] = buf
+    state.row_updates[name] = (x.data.astype(store_dtype), axis)
     return nt(buf.astype(x.dtype), full_dims)
 
 

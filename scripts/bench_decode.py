@@ -6,9 +6,13 @@ lines.  Run on the TPU chip:
 
   nohup python scripts/bench_decode.py --batches 1,8,32 > decode_bench.log &
 
-Timing notes (docs/PERFORMANCE.md): the whole generation runs inside ONE
-jitted while_loop call, so per-dispatch tunnel latency amortises; sync is by
-value materialisation.
+Timing notes (docs/PERFORMANCE.md): the flagship numbers run the whole
+generation inside ONE jitted while_loop call, so per-dispatch tunnel latency
+amortises; sync is by value materialisation.  ``--probe`` (and ``run()``,
+the bench.py companion) instead measures the big-cache sequence-scaling
+probe through the STEPPED donated-carry loop — ms/token at 8k/16k/32k for
+bf16 and int8 caches, the tracked regression metric for the in-place
+cache-carry property (docs/PERFORMANCE.md 'Big-cache decode').
 """
 import argparse
 import json
@@ -17,6 +21,160 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# sequence-scaling probe recipe (BASELINE.md round 5): a quarter-width
+# 1b_long_context-style mixer — decode cost should be LINEAR in cache bytes
+# (one cache read per token), so ms/token at 8k must be ~1/4 of 32k; the
+# fused-loop regression showed 6x for the 4x cache (cache-carry copies)
+PROBE_CONFIG = {
+    "model_mode": "gpt", "use_video": False, "use_language": True,
+    "features_per_head": 256, "heads": 16, "depth": 13,
+    "train_batch_size": 1, "vocab_size": 256, "calc_accuracy": False,
+    "memory_reduction_strategy": "revnet",
+    "block_config": [
+        {"layer": ["norm-shift-scale-features-group",
+                   "bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:shift-mid:scale-mid:features"]},
+        {"layer": ["norm-shift-scale-features-group",
+                   "attention-dot_product-context-in:relu"]}],
+    "group_linear_factor": 2,
+    "intermediate_feed_forward_multiplier_multiplier": 0.5,
+    "calculation_dtype": "bfloat16", "storage_dtype": "bfloat16",
+    "scan_layers": True, "use_checkpointing": False,
+    "model_path": "/tmp/bench_decode_probe",
+}
+
+
+def _measure_stepped(model, variables, token_x, gen: int) -> dict:
+    """Steady-state decode ms/token at a FULL cache: prefill to
+    ``seq - gen - 1`` in its own jitted call (timed separately as TTFT),
+    then time the donated chunk loop over the last ``gen`` tokens —
+    prefill cost and compile are excluded from the per-token figure."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from homebrewnlp_tpu.infer.sampler import _jit_sampler
+
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    n0 = seq - gen - 1
+    ipb = jnp.full((batch,), n0 + 1, jnp.int32)
+    tb = jnp.zeros((batch,), jnp.float32)
+    prep = _jit_sampler(model, None, "kv_prep")
+    token_x, _ = prep(jnp.asarray(token_x), ipb)
+    pf = _jit_sampler(model, None, "kv_prefill_caches")
+    t0 = time.time()
+    caches = pf(variables, token_x, jnp.asarray(n0, jnp.int32))
+    # sync by value materialisation (the tunnel's block_until_ready can
+    # return early); one scalar read forces the dispatched chain
+    np.asarray(jax.tree_util.tree_leaves(caches)[0].ravel()[:1])
+    ttft = time.time() - t0
+
+    step = _jit_sampler(model, None, "kv_step")
+    chunk = max(1, int(model.params.decode_chunk_tokens))
+    end = jnp.asarray(seq, jnp.int32)
+    carry = (jnp.asarray(n0, jnp.int32), token_x, caches,
+             jax.random.PRNGKey(0))
+    # a SHORT warmup chunk compiles the step; timing starts after it so
+    # most of ``gen`` lands in the timed window.  min(4, gen - 1) always
+    # leaves >= 1 timed step — a zero-step window would silently report
+    # ~0 ms/token for the tracked metric
+    # (at gen == 1 the warmup call is a no-op that still compiles)
+    warm = n0 + min(4, max(seq - 1 - n0 - 1, 0))
+    carry = step(variables, ipb, tb, end, jnp.asarray(warm, jnp.int32),
+                 (), carry)
+    q = int(carry[0])
+    t0 = time.time()
+    while q < seq - 1:
+        q_hi = min(q + chunk, seq - 1)
+        carry = step(variables, ipb, tb, end,
+                     jnp.asarray(q_hi, jnp.int32), (), carry)
+        q = q_hi
+    np.asarray(carry[0])  # value sync
+    dt = time.time() - t0
+    timed = (seq - 1) - warm
+    if timed < 1:
+        raise ValueError(f"gen={gen} leaves no timed decode steps")
+    return {"ms_per_token": dt / timed * 1e3,
+            "prefill_ttft_s": round(ttft, 3)}
+
+
+def run(seqs=None, cache_dtypes=("bfloat16", "int8"), gen: int = 128) -> dict:
+    """Decode-latency companion (bench.py): ms/token across sequence
+    lengths and cache dtypes on the probe recipe, plus the 32k/8k scaling
+    ratio the tier-1 regression metric tracks.  Returns the bench.py
+    companion dict; ``value`` is the largest-context int8 ms/token."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.infer.sampler import decode_cache_bytes
+    from homebrewnlp_tpu.model import Model
+
+    cfg = dict(PROBE_CONFIG)
+    on_cpu = jax.default_backend() == "cpu"
+    if seqs is None:
+        seqs = (512, 1024, 2048) if on_cpu else (8192, 16384, 32768)
+    if on_cpu:
+        # CPU fallback keeps the STRUCTURE measurable (scaling ratio, loop
+        # path) at shapes a CPU can decode in seconds
+        cfg.update(features_per_head=32, heads=2, depth=4)
+        gen = min(gen, 32)
+
+    rows = []
+    by_key = {}
+    for cache_dtype in cache_dtypes:
+        for seq in seqs:
+            try:
+                # the WHOLE per-shape body is guarded: a largest-context
+                # failure anywhere (init OOM included) keeps the rows the
+                # smaller shapes already measured
+                c = dict(cfg, sequence_length=int(seq),
+                         decode_cache_dtype=cache_dtype)
+                params = ModelParameter(c, train=False)
+                model = Model(params)
+                tps = params.token_patch_size
+                x = np.zeros((1, seq // tps, tps), np.int32)
+                variables = {k: jnp.asarray(v) for k, v in
+                             model.init({"token_x": x,
+                                         "token_y": x}).items()}
+                rng = np.random.default_rng(0)
+                token_x = rng.integers(0, params.vocab_size, x.shape
+                                       ).astype(np.int32)
+                res = _measure_stepped(model, variables,
+                                       jnp.asarray(token_x), gen)
+                nbytes = decode_cache_bytes(model, variables, token_x)
+            except Exception as exc:
+                rows.append({"seq": int(seq), "cache_dtype": cache_dtype,
+                             "error": repr(exc)[:200]})
+                continue
+            row = {"seq": int(seq), "cache_dtype": cache_dtype,
+                   "ms_per_token": round(res["ms_per_token"], 3),
+                   "prefill_ttft_s": res["prefill_ttft_s"],
+                   "cache_gb": round(nbytes / 1024 ** 3, 3)}
+            rows.append(row)
+            by_key[(cache_dtype, int(seq))] = dict(row, cache_bytes=nbytes)
+
+    out = {"metric": f"decode ms/token @ probe recipe, batch 1, "
+                     f"seqs {'/'.join(str(s) for s in seqs)}",
+           "unit": "ms/token", "rows": rows}
+    big, small = (by_key.get(("int8", seqs[-1])),
+                  by_key.get(("int8", seqs[0])))
+    if big and small:
+        # largest-vs-smallest measured context (8k/32k on TPU; named
+        # generically because the CPU fallback runs shrunk seqs and the
+        # two must not be read as the same metric)
+        out["value"] = big["ms_per_token"]
+        out["scaling_ratio_large_small"] = round(
+            big["ms_per_token"] / small["ms_per_token"], 3)
+        out["byte_ratio_large_small"] = round(
+            big["cache_bytes"] / small["cache_bytes"], 3)
+    else:
+        # fall back to the last SUCCESSFUL row: a trailing per-shape
+        # failure (e.g. the largest context OOMing) must not discard the
+        # measured rows from the companion line
+        ok = [r for r in rows if "ms_per_token" in r]
+        if ok:
+            out["value"] = ok[-1]["ms_per_token"]
+    return out
 
 
 def main():
@@ -33,7 +191,15 @@ def main():
     ap.add_argument("--quantized", action="store_true",
                     help="weight-only int8 (infer/quant.py): halves the "
                          "weight bytes the decode matvecs stream per token")
+    ap.add_argument("--probe", action="store_true",
+                    help="run the big-cache sequence-scaling probe "
+                         "(ms/token at 8k/16k/32k, bf16+int8 caches) "
+                         "through the stepped decode loop and exit")
     args = ap.parse_args()
+
+    if args.probe:
+        print(json.dumps(run()), flush=True)
+        return
 
     import jax
     import jax.numpy as jnp

@@ -169,98 +169,105 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
     from homebrewnlp_tpu.utils.flops import device_hbm_bytes
     target_hbm = device_hbm_bytes(devices[0])
     cap_key = "HBNLP_FUSED_DQP_CAP_GB"
-    cap_prev = os.environ.get(cap_key)
-    os.environ[cap_key] = str(0.30 * target_hbm / 1024 ** 3)
 
-    seq = params.sequence_length // params.token_patch_size
-    batch_np = {
-        "token_x": np.zeros((params.train_batch_size, seq,
-                             params.token_patch_size), np.int32),
-        "token_y": np.zeros((params.train_batch_size, seq,
-                             params.token_patch_size), np.int32)}
+    def _lower_with_cap():
+        seq = params.sequence_length // params.token_patch_size
+        batch_np = {
+            "token_x": np.zeros((params.train_batch_size, seq,
+                                 params.token_patch_size), np.int32),
+            "token_y": np.zeros((params.train_batch_size, seq,
+                                 params.token_patch_size), np.int32)}
 
-    undo = _patch_cheap_init()
-    try:
-        variables = model.init(batch_np)
-    finally:
-        undo()
-    trainer.optimizer = __import__(
-        "homebrewnlp_tpu.optim", fromlist=["Optimizer"]).Optimizer(
-            params, model.param_dims)
+        undo = _patch_cheap_init()
+        try:
+            variables = model.init(batch_np)
+        finally:
+            undo()
+        trainer.optimizer = __import__(
+            "homebrewnlp_tpu.optim", fromlist=["Optimizer"]).Optimizer(
+                params, model.param_dims)
 
-    var_avals = {
-        k: jax.ShapeDtypeStruct(
-            np.shape(v), np.asarray(v).dtype,
-            sharding=shardlib.named_sharding(
-                params, model.param_dims.get(k, ()), mesh))
-        for k, v in variables.items()}
-    n_params = sum(int(np.prod(a.shape)) for a in var_avals.values())
-    del variables  # free the host zeros before compiling
+        var_avals = {
+            k: jax.ShapeDtypeStruct(
+                np.shape(v), np.asarray(v).dtype,
+                sharding=shardlib.named_sharding(
+                    params, model.param_dims.get(k, ()), mesh))
+            for k, v in variables.items()}
+        n_params = sum(int(np.prod(a.shape)) for a in var_avals.values())
+        del variables  # free the host zeros before compiling
 
-    opt_avals = _opt_state_avals(trainer.optimizer, var_avals, mesh)
-    repl = NamedSharding(mesh, PartitionSpec())
-    state_avals = TrainState(
-        var_avals, opt_avals,
-        jax.ShapeDtypeStruct((), np.int32, sharding=repl))
+        opt_avals = _opt_state_avals(trainer.optimizer, var_avals, mesh)
+        repl = NamedSharding(mesh, PartitionSpec())
+        state_avals = TrainState(
+            var_avals, opt_avals,
+            jax.ShapeDtypeStruct((), np.int32, sharding=repl))
 
-    batch_entries = [None] * 3
-    if params.train_batch_size % mesh.shape.get("data", 1) == 0:
-        batch_entries[0] = "data"
-    batch_sharding = NamedSharding(mesh, PartitionSpec(*batch_entries))
-    batch_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
-                                           sharding=batch_sharding)
-                   for k, v in batch_np.items()}
-    rng_aval = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
+        batch_entries = [None] * 3
+        if params.train_batch_size % mesh.shape.get("data", 1) == 0:
+            batch_entries[0] = "data"
+        batch_sharding = NamedSharding(mesh, PartitionSpec(*batch_entries))
+        batch_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                               sharding=batch_sharding)
+                       for k, v in batch_np.items()}
+        rng_aval = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
 
-    step_fn = trainer._build_step()
-    t_trace = time.time()
-    try:
+        step_fn = trainer._build_step()
+        t_trace = time.time()
         lowered = step_fn.lower(state_avals, batch_avals, rng_aval)
         t_lower = time.time()
         compiled = lowered.compile()
         t_compile = time.time()
+
+        ma = compiled.memory_analysis()
+        hlo = compiled.as_text()
+        inventory = _collective_inventory(hlo)
+
+        hbm = HBM_BYTES[hbm_key]
+        # donated state aliases the output, so peak live ≈ arguments (params +
+        # opt state + batch) + XLA temporaries (activations, stash, collective
+        # buffers); generated code is tiny by comparison but counted
+        peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + ma.generated_code_size_in_bytes)
+        gib = 1024 ** 3
+        report = {
+            "config": config_path,
+            "topology": topology,
+            "devices": len(devices),
+            "device_kind": str(devices[0].device_kind),
+            "mesh": dict(mesh.shape),
+            "n_params": n_params,
+            "per_chip": {
+                "arguments_gib": round(ma.argument_size_in_bytes / gib, 3),
+                "output_gib": round(ma.output_size_in_bytes / gib, 3),
+                "temp_gib": round(ma.temp_size_in_bytes / gib, 3),
+                "alias_gib": round(ma.alias_size_in_bytes / gib, 3),
+                "code_gib": round(ma.generated_code_size_in_bytes / gib, 3),
+                "peak_estimate_gib": round(peak / gib, 3),
+                "hbm_gib": round(hbm / gib, 2),
+                "fits": bool(peak < hbm),
+            },
+            "collectives": inventory,
+            "timings_s": {"setup": round(t_trace - t0, 1),
+                          "trace_lower": round(t_lower - t_trace, 1),
+                          "compile": round(t_compile - t_lower, 1)},
+        }
+        if keep_hlo_lines:
+            report["hlo_head"] = hlo.splitlines()[:keep_hlo_lines]
+        return report
+
+    cap_prev = os.environ.get(cap_key)
+    os.environ[cap_key] = str(0.30 * target_hbm / 1024 ** 3)
+    # the restore spans EVERYTHING from the assignment on (it used to wrap
+    # only lower()/compile()): an exception in init/aval construction below
+    # would otherwise leak the target-chip cap into the process env,
+    # silently mis-budgeting every later lowering in the same process
+    try:
+        return _lower_with_cap()
     finally:
         if cap_prev is None:
             os.environ.pop(cap_key, None)
         else:
             os.environ[cap_key] = cap_prev
-
-    ma = compiled.memory_analysis()
-    hlo = compiled.as_text()
-    inventory = _collective_inventory(hlo)
-
-    hbm = HBM_BYTES[hbm_key]
-    # donated state aliases the output, so peak live ≈ arguments (params +
-    # opt state + batch) + XLA temporaries (activations, stash, collective
-    # buffers); generated code is tiny by comparison but counted
-    peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
-            + ma.generated_code_size_in_bytes)
-    gib = 1024 ** 3
-    report = {
-        "config": config_path,
-        "topology": topology,
-        "devices": len(devices),
-        "device_kind": str(devices[0].device_kind),
-        "mesh": dict(mesh.shape),
-        "n_params": n_params,
-        "per_chip": {
-            "arguments_gib": round(ma.argument_size_in_bytes / gib, 3),
-            "output_gib": round(ma.output_size_in_bytes / gib, 3),
-            "temp_gib": round(ma.temp_size_in_bytes / gib, 3),
-            "alias_gib": round(ma.alias_size_in_bytes / gib, 3),
-            "code_gib": round(ma.generated_code_size_in_bytes / gib, 3),
-            "peak_estimate_gib": round(peak / gib, 3),
-            "hbm_gib": round(hbm / gib, 2),
-            "fits": bool(peak < hbm),
-        },
-        "collectives": inventory,
-        "timings_s": {"setup": round(t_trace - t0, 1),
-                      "trace_lower": round(t_lower - t_trace, 1),
-                      "compile": round(t_compile - t_lower, 1)},
-    }
-    if keep_hlo_lines:
-        report["hlo_head"] = hlo.splitlines()[:keep_hlo_lines]
-    return report
 
 
 def main() -> int:
