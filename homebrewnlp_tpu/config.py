@@ -290,6 +290,36 @@ class ModelParameter:
         # exponential backoff from base_delay, jittered (utils/retry.py)
         self.storage_retry_attempts = 5
         self.storage_retry_base_delay = 0.5
+        # ---- serving fault tolerance (docs/RELIABILITY.md 'Serving') ----
+        # admission control: pending-request budget for the isolated REST
+        # path; at/above it the HTTP child answers 429 + Retry-After instead
+        # of enqueueing.  0 = unbounded (reference parity)
+        self.serve_queue_limit = 64
+        # per-request deadline cap AND default (seconds): clients may pass
+        # a smaller timeout_s; expired requests are shed and answered 504
+        # instead of silently burning the client's whole timeout
+        self.serve_request_deadline_s = 120.0
+        # HTTP bodies above this are rejected 400 before being read; 0 = off
+        self.serve_max_body_bytes = 1 << 20
+        # max_tokens above this cap rejects 400 at the HTTP edge, and an
+        # omitted/0 max_tokens is capped to it at parse time; 0 = off
+        # (over-asks clamp to the sequence, the pre-guard behavior)
+        self.serve_max_response_tokens = 0
+        # circuit breaker: after N CONSECUTIVE decode failures requests
+        # fast-fail 503 + Retry-After for the cooldown, then one probe
+        # half-opens.  0 = breaker off
+        self.serve_breaker_threshold = 5
+        self.serve_breaker_cooldown_s = 30.0
+        # supervision: a crashed HTTP subprocess is relaunched with
+        # exponential backoff from the base delay, at most this many times
+        # (0 = die on first child exit, the pre-guard behavior)
+        self.serve_child_max_restarts = 5
+        self.serve_child_restart_backoff_s = 0.5
+        # /health answers 503 "stale" once the device-loop heartbeat is
+        # older than this, so a status-code-only liveness probe restarts a
+        # permanently wedged loop.  0 = off (a long decode also ages the
+        # heartbeat — pick a threshold above the worst-case decode)
+        self.serve_heartbeat_stale_s = 0.0
 
         self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():
@@ -311,6 +341,21 @@ class ModelParameter:
             # retry with a ValueError masking the real storage error
             raise ValueError("storage_retry_base_delay must be >= 0, got "
                              f"{self.storage_retry_base_delay}")
+        # serving-guard knobs: 0 disables the mechanism; a negative value is
+        # always a typo and would surface as bizarre behavior deep in the
+        # serve loop (e.g. time.sleep raising)
+        for knob in ("serve_queue_limit", "serve_max_body_bytes",
+                     "serve_max_response_tokens", "serve_breaker_threshold",
+                     "serve_breaker_cooldown_s", "serve_child_max_restarts",
+                     "serve_child_restart_backoff_s",
+                     "serve_heartbeat_stale_s"):
+            v = getattr(self, knob)
+            if v < 0:
+                raise ValueError(f"{knob} must be >= 0, got {v}")
+        if self.serve_request_deadline_s <= 0:
+            raise ValueError("serve_request_deadline_s must be > 0 (it is "
+                             "the default deadline, not just a cap), got "
+                             f"{self.serve_request_deadline_s}")
         # the serving-default repetition penalty reaches _repetition_penalty
         # whenever a request omits a value (sample mode, REPL, batched
         # rows); r <= 0 would inf/NaN seen tokens' logits — apply the same

@@ -135,6 +135,15 @@ class InterfaceWrapper:
                 "cache_dtype": str(p.decode_cache_dtype or
                                    p.calculation_dtype)}
 
+    @property
+    def prompt_capacity(self) -> int:
+        """Longest prompt (in tokens) a completion can consume: one token
+        position must remain for generation, so ``complete_tokens`` CLIPS
+        prompts to ``seq - 1``.  The REST layer reads this to surface
+        ``"truncated": true`` instead of letting a clipped prompt look like
+        a short answer (rest_api._handlers / _complete_batch)."""
+        return self.params.sequence_length // self.params.token_patch_size - 1
+
     def complete_tokens(self, tokens: np.ndarray, temperature: float = 0.0,
                         response_len: typing.Optional[int] = None,
                         seed: int = 0, top_k: int = None,

@@ -1,9 +1,10 @@
 """REST serving mode (reference: /root/reference/src/rest_api.py).
 
-Endpoints: /completion, /token_completion, /encode, /decode, mirroring the
-reference's RestAPI surface (:74-89).  fastapi/uvicorn are optional — when
-absent (as in this image) a dependency-free fallback HTTP server provides the
-same JSON endpoints so web_api mode always works.
+Endpoints: /completion, /token_completion, /encode, /decode, /health,
+/ready, mirroring the reference's RestAPI surface (:74-89) plus the
+reliability surface from docs/RELIABILITY.md 'Serving'.  fastapi/uvicorn
+are optional — when absent (as in this image) a dependency-free fallback
+HTTP server provides the same JSON endpoints so web_api mode always works.
 
 Process isolation (default): the HTTP server runs in a daemon SUBPROCESS and
 talks to the device loop through Manager-dict/queue IPC, the reference's
@@ -12,6 +13,14 @@ interface.py:231-280) — HTTP parsing and slow clients never block the device
 loop, and completions are strictly serialized onto the device from one
 process.  ``isolate=False`` keeps everything in-process (handy for tests and
 notebook use).
+
+The isolated path is guarded by infer/serving_guard.py: admission control
+(429 when the pending budget is full, 400 for requests that cannot succeed),
+per-request deadlines (504, shed at batch assembly), a circuit breaker (503
+fast-fail after consecutive decode failures), a device-loop heartbeat with
+/health + /ready answered by the HTTP child WITHOUT crossing the device
+loop, and bounded-backoff relaunch of a crashed HTTP child.  Every accepted
+request receives exactly one JSON answer.
 """
 from __future__ import annotations
 
@@ -22,55 +31,182 @@ import uuid
 
 from ..config import ModelParameter
 from .interface import InterfaceWrapper
+from .serving_guard import (HTTPStatusError, ServingGuard, child_health,
+                            child_ready, poll_delay, request_deadline_s,
+                            serve_config, validate_request)
 
 DEFAULT_PORT = 62220
 
+BATCHED_PATHS = ("/completion", "/token_completion")
+# endpoints load balancers / k8s probe with GET (POST works on them too)
+PROBE_PATHS = ("/health", "/ready")
+
+# error payloads ride the responses dict as {"_error": ..., "_status": ...,
+# "_code": ...[, "_retry_after": ...]}; the HTTP child renders them with the
+# recorded status instead of a blanket 500
+_BAD_REQUEST = {"_status": 400, "_code": "bad_request"}
+_SERVER_ERROR = {"_status": 500, "_code": "server_error"}
+_TIMEOUT = {"_status": 504, "_code": "timeout"}
+_UNAVAILABLE = {"_status": 503, "_code": "unavailable"}
+
+# exception types request PARSING raises on malformed-but-valid-JSON input
+# (np.asarray on nulls -> TypeError, out-of-int32 tokens / int(Infinity) ->
+# OverflowError, filters -> ValueError): answered 400 and — critically —
+# NEVER counted as decode failures, or one malformed client could trip the
+# breaker and 503 the whole server
+_CLIENT_ERRORS = (ValueError, TypeError, OverflowError)
+
+
+def _err(exc_or_msg, kind: dict) -> dict:
+    return {"_error": str(exc_or_msg), **kind}
+
+
+def _prompt_capacity(interface) -> int:
+    """InterfaceWrapper.prompt_capacity, with the same ``seq - 1`` fallback
+    for interface-alikes (test stubs) that don't define it."""
+    cap = getattr(interface, "prompt_capacity", None)
+    if cap is not None:
+        return int(cap)
+    p = interface.params
+    return p.sequence_length // p.token_patch_size - 1
+
+
+def _parse_completion(interface, path: str, body: dict):
+    """Parse a /completion / /token_completion body into decode arguments
+    ``(tokens, temperature, response_len, top_k, top_p, rep_penalty)``.
+    Raises on malformed input — the ONE definition of "client error" for
+    completion requests, shared by the handlers, the batch parse loop and
+    the single-request pre-check so parse failures (400, never
+    breaker-counted) and decode failures (500, breaker-counted) cannot
+    drift apart."""
+    import numpy as np
+    if path == "/completion":
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str):
+            # tokenizer.encode on a non-str raises AttributeError, which
+            # would (rightly) classify as a server fault — name the real
+            # problem as the client error it is
+            raise ValueError("prompt must be a string")
+        toks = interface.tokenizer.encode(prompt)
+    else:
+        toks = np.asarray(body.get("tokens", []), np.int32).reshape(-1)
+    mt = body.get("max_tokens")
+    rl = int(mt) if mt else None
+    # serve_max_response_tokens bounds the decode cost of EVERY request:
+    # explicit values above it were already rejected 400 at the edge, and an
+    # omitted / 0 max_tokens (= "decode the full sequence") is capped here —
+    # otherwise the default-shaped request would bypass the cap entirely
+    cap = int(getattr(interface.params, "serve_max_response_tokens", 0) or 0)
+    if cap:
+        rl = cap if rl is None else min(rl, cap)
+    temp = float(body.get("temperature", 0.0))
+    tk, tp, rp = _parse_filters(body)
+    return toks, temp, rl, tk, tp, rp
+
+
+def _format_completion(interface, path: str, prompt_toks, out,
+                       kept_limit: int) -> dict:
+    kept = min(len(prompt_toks), kept_limit)
+    if path == "/completion":
+        # slice at the KEPT prompt length: on a clipped prompt, the raw
+        # prompt length would cut into (or past) the generated tokens
+        r = {"completion": interface.tokenizer.decode(out[kept:])}
+    else:
+        r = {"tokens": [int(t) for t in out]}
+    if len(prompt_toks) > kept_limit:
+        # surface the silent prompt clip so a client can tell a short
+        # answer from a truncated prompt; absent on unclipped requests
+        # so the happy path stays byte-identical
+        r["truncated"] = True
+        r["prompt_tokens_kept"] = kept_limit
+    return r
+
+
+def _complete_one(interface, path: str, parsed) -> dict:
+    """Decode + format ONE parsed completion request — the single shared
+    decode path for the handlers and the device loop's single-request
+    branch (parsing already happened; any exception here is a decode
+    failure)."""
+    toks, temp, rl, tk, tp, rp = parsed
+    out = interface.complete_tokens(toks, temp, rl, top_k=tk, top_p=tp,
+                                    repetition_penalty=rp)
+    return _format_completion(interface, path, toks, out,
+                              _prompt_capacity(interface))
+
 
 def _complete_batch(interface: InterfaceWrapper,
-                    items: typing.List[typing.Tuple[str, dict]]
+                    items: typing.List[typing.Tuple[str, dict]],
+                    deadlines: typing.Optional[typing.List[typing.Optional[float]]] = None,
+                    guard: typing.Optional[ServingGuard] = None,
+                    clock: typing.Callable[[], float] = time.monotonic
                     ) -> typing.List[dict]:
     """N queued /completion + /token_completion requests -> ONE decode call
     (InterfaceWrapper.complete_tokens_batch).  Per-item parse errors answer
-    that item with an ``_error`` payload without failing the batch."""
-    import numpy as np
+    that item with a 400 ``_error`` payload without failing the batch; a
+    FAILED batch decode retries the items individually once (per-row
+    isolation — one poisoned request can't fail its co-batched neighbors)
+    and counts the event in the failure counter the breaker reads."""
+    kept_limit = _prompt_capacity(interface)
     prompts, temps, rls, tks, tps, rps, idx = [], [], [], [], [], [], []
     results: typing.List[typing.Optional[dict]] = [None] * len(items)
     for i, (path, body) in enumerate(items):
         try:
-            if path == "/completion":
-                toks = interface.tokenizer.encode(body.get("prompt", ""))
-            else:
-                toks = np.asarray(body.get("tokens", []), np.int32).reshape(-1)
-            mt = body.get("max_tokens")
-            prompts.append(toks)
-            temps.append(float(body.get("temperature", 0.0)))
-            rls.append(int(mt) if mt else None)
-            tk, tp, rp = _parse_filters(body)
-            tks.append(tk)
-            tps.append(tp)
-            rps.append(rp)
-            idx.append(i)
+            # parse EVERYTHING before appending to ANY list: a mid-parse
+            # exception (e.g. _parse_filters) must not leave the parallel
+            # lists misaligned — row j would then decode row j+1's prompt
+            # and answer it to the wrong client
+            toks, temp, rl, tk, tp, rp = _parse_completion(interface, path,
+                                                           body)
         except Exception as e:
-            results[i] = {"_error": str(e)}
+            results[i] = _err(e, _BAD_REQUEST)
+            continue
+        prompts.append(toks)
+        temps.append(temp)
+        rls.append(rl)
+        tks.append(tk)
+        tps.append(tp)
+        rps.append(rp)
+        idx.append(i)
+
+    def _format(i: int, j: int, out) -> dict:
+        return _format_completion(interface, items[i][0], prompts[j], out,
+                                  kept_limit)
+
     if idx:
         try:
             outs = interface.complete_tokens_batch(prompts, temps, rls,
                                                    top_ks=tks, top_ps=tps,
                                                    rep_penalties=rps)
             for j, i in enumerate(idx):
-                path, _ = items[i]
-                if path == "/completion":
-                    results[i] = {"completion": interface.tokenizer.decode(
-                        outs[j][len(prompts[j]):])}
-                else:
-                    results[i] = {"tokens": [int(t) for t in outs[j]]}
-        except Exception as e:
-            for i in idx:
-                results[i] = {"_error": str(e)}
+                results[i] = _format(i, j, outs[j])
+            if guard is not None:
+                guard.record_decode_success()
+        except Exception:
+            if guard is not None:
+                guard.record_decode_failure()
+            # per-row isolation: retry each item individually ONCE, so the
+            # poisoned request fails alone instead of taking the batch down
+            for j, i in enumerate(idx):
+                dl = deadlines[i] if deadlines else None
+                if dl is not None and clock() >= dl:
+                    results[i] = _err("deadline expired during the batch "
+                                      "retry", _TIMEOUT)
+                    continue
+                try:
+                    out = interface.complete_tokens(
+                        prompts[j], temps[j], rls[j], top_k=tks[j],
+                        top_p=tps[j], repetition_penalty=rps[j])
+                    results[i] = _format(i, j, out)
+                    if guard is not None:
+                        guard.record_decode_success()
+                except Exception as e:
+                    # parsing already succeeded in the loop above, so ANY
+                    # exception here — ValueError included — is the decode
+                    # failing: a server fault the breaker must see
+                    if guard is not None:
+                        guard.record_decode_failure()
+                    results[i] = _err(e, _SERVER_ERROR)
     return results
-
-
-BATCHED_PATHS = ("/completion", "/token_completion")
 
 
 def _parse_filters(body: dict):
@@ -82,7 +218,7 @@ def _parse_filters(body: dict):
     rp = body.get("repetition_penalty")
     if rp is not None and float(rp) <= 0:
         # r <= 0 would turn seen tokens' logits into inf/NaN downstream —
-        # reject loudly (batched path answers the item with _error)
+        # reject loudly (the ValueError renders as HTTP 400)
         raise ValueError(f"repetition_penalty must be > 0, got {rp}")
     return (int(tk) if tk is not None else None,
             float(tp) if tp is not None else None,
@@ -91,29 +227,20 @@ def _parse_filters(body: dict):
 
 def _handlers(interface: InterfaceWrapper):
     def completion(body: dict) -> dict:
-        prompt = body.get("prompt", "")
-        temperature = float(body.get("temperature", 0.0))
-        max_tokens = body.get("max_tokens")
-        tk, tp, rp = _parse_filters(body)
-        text = interface.complete(prompt, temperature,
-                                  int(max_tokens) if max_tokens else None,
-                                  top_k=tk, top_p=tp, repetition_penalty=rp)
-        return {"completion": text}
+        return _complete_one(interface, "/completion",
+                             _parse_completion(interface, "/completion",
+                                               body))
 
     def token_completion(body: dict) -> dict:
-        import numpy as np
-        tokens = np.asarray(body.get("tokens", []), np.int32)
-        temperature = float(body.get("temperature", 0.0))
-        max_tokens = body.get("max_tokens")
-        tk, tp, rp = _parse_filters(body)
-        out = interface.complete_tokens(tokens, temperature,
-                                        int(max_tokens) if max_tokens else None,
-                                        top_k=tk, top_p=tp,
-                                        repetition_penalty=rp)
-        return {"tokens": [int(t) for t in out]}
+        return _complete_one(interface, "/token_completion",
+                             _parse_completion(interface, "/token_completion",
+                                               body))
 
     def encode(body: dict) -> dict:
-        return {"tokens": [int(t) for t in interface.tokenizer.encode(body.get("prompt", ""))]}
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str):
+            raise ValueError("prompt must be a string")
+        return {"tokens": [int(t) for t in interface.tokenizer.encode(prompt)]}
 
     def decode(body: dict) -> dict:
         return {"prompt": interface.tokenizer.decode(body.get("tokens", []))}
@@ -123,7 +250,10 @@ def _handlers(interface: InterfaceWrapper):
         stepped in-place cache carry vs the fused while_loop — the config's
         ``decode_loop`` knob resolved against the actual cache size) plus
         the decode-call counter.  ``width`` selects a batched-serving
-        width; default is the deployment's serve width."""
+        width; default is the deployment's serve width.  In the isolated
+        path this handler is only reached from the in-process fallback —
+        the HTTP child answers /health itself (serving_guard.child_health)
+        so liveness never crosses the device loop."""
         p = interface.params
         width = int(body.get("width") or 0) or None
         return {"status": "ok",
@@ -131,24 +261,125 @@ def _handlers(interface: InterfaceWrapper):
                 "serve_batch_size": int(getattr(p, "serve_batch_size", 1)),
                 "decode_path": interface.decode_path(width)}
 
+    def ready(body: dict) -> dict:
+        """In-process readiness: serving means the model is loaded and there
+        is no queue or breaker in front of it."""
+        return {"ready": True, "breaker": "closed", "queue_depth": 0}
+
     return {"/completion": completion, "/token_completion": token_completion,
-            "/encode": encode, "/decode": decode, "/health": health}
+            "/encode": encode, "/decode": decode, "/health": health,
+            "/ready": ready}
+
+
+def _retry_after_header(retry_after: typing.Optional[float]
+                        ) -> typing.Optional[str]:
+    # Retry-After is integer seconds; round UP so "0.4s left" doesn't tell
+    # the client to hammer immediately
+    if retry_after is None:
+        return None
+    return str(max(1, int(retry_after + 0.999)))
 
 
 def _run_http(port: int, paths: typing.List[str],
-              dispatch: typing.Callable[[str, dict], dict], workers: int = 1):
+              dispatch: typing.Callable[[str, dict], dict], workers: int = 1,
+              max_body_bytes: typing.Optional[int] = None):
     """Serve the endpoint set over HTTP, blocking.  ``dispatch(path, body)``
-    produces the JSON response (directly, or via IPC to the device loop)."""
+    produces the JSON response (directly, or via IPC to the device loop).
+
+    Error classification (satellite: client errors are not server faults):
+    oversized/malformed bodies and ValueErrors (e.g. _parse_filters
+    rejecting ``repetition_penalty <= 0``) answer 400 with a structured
+    ``{"error": ..., "code": "bad_request"}`` payload; HTTPStatusError
+    carries its own status (429/503/504 from the guard); anything else is a
+    genuine server fault and stays 500."""
     try:
         import fastapi
         import uvicorn
+        from fastapi.responses import JSONResponse
         app = fastapi.FastAPI()
+        if max_body_bytes:
+            # same pre-read rejection as the fallback server: an oversized
+            # body must not cost memory, parsing, or a device call
+            @app.middleware("http")
+            async def _limit_body(request, call_next):
+                if "chunked" in request.headers.get("transfer-encoding",
+                                                    "").lower():
+                    # no upfront length to check against the cap — reject
+                    # rather than buffer an unbounded body
+                    return JSONResponse(
+                        {"error": "chunked request bodies are not accepted "
+                                  "(serve_max_body_bytes is enforced on "
+                                  "Content-Length)",
+                         "code": "bad_request"}, status_code=400)
+                try:
+                    length = int(request.headers.get("content-length") or 0)
+                except ValueError:
+                    return JSONResponse(
+                        {"error": "malformed Content-Length header",
+                         "code": "bad_request"}, status_code=400)
+                if length > max_body_bytes:
+                    return JSONResponse(
+                        {"error": f"request body of {length} bytes exceeds "
+                                  f"serve_max_body_bytes={max_body_bytes}",
+                         "code": "bad_request"}, status_code=400)
+                return await call_next(request)
+        def _run_dispatch(p, body):
+            # JSONResponse, not HTTPException: the payload must stay at the
+            # TOP level ({"error", "code"}), the one contract both server
+            # branches share — HTTPException would wrap it under
+            # {"detail": ...}
+            try:
+                return dispatch(p, body)
+            except HTTPStatusError as e:
+                ra = _retry_after_header(e.retry_after)
+                return JSONResponse(
+                    e.payload, status_code=e.status,
+                    headers={"Retry-After": ra} if ra else None)
+            except _CLIENT_ERRORS as e:
+                return JSONResponse(
+                    {"error": str(e), "code": "bad_request"},
+                    status_code=400)
+            except Exception as e:
+                return JSONResponse(
+                    {"error": str(e), "code": "server_error"},
+                    status_code=500)
+
+        from fastapi.concurrency import run_in_threadpool
         for path in paths:
             def make_endpoint(p=path):
-                async def endpoint(body: dict):
-                    return dispatch(p, body)
+                # parse the body by hand (pydantic's `body: dict` would
+                # answer 422 {"detail": ...} for non-object bodies, breaking
+                # the shared 400 contract) and run the BLOCKING dispatch
+                # poll in the threadpool — on the event loop it would stall
+                # every concurrent request, /health probes included, for up
+                # to the full request deadline
+                async def endpoint(request: fastapi.Request):
+                    try:
+                        body = json.loads(await request.body() or b"{}")
+                    except Exception as e:
+                        return JSONResponse(
+                            {"error": f"malformed JSON body: {e}",
+                             "code": "bad_request"}, status_code=400)
+                    if not isinstance(body, dict):
+                        return JSONResponse(
+                            {"error": "JSON object body required",
+                             "code": "bad_request"}, status_code=400)
+                    if p in PROBE_PATHS:
+                        # probes are sub-ms shared-state reads: answered
+                        # inline, NOT via the threadpool, whose bounded
+                        # tokens slow completion polls can exhaust — the
+                        # probes must stay responsive exactly then
+                        return _run_dispatch(p, body)
+                    return await run_in_threadpool(_run_dispatch, p, body)
                 return endpoint
             app.post(path)(make_endpoint())
+            if path in PROBE_PATHS:
+                # load balancers and k8s probe with GET
+                def make_get(p=path):
+                    async def get_endpoint():
+                        return _run_dispatch(p, {})
+                    return get_endpoint
+                app.get(path)(make_get())
         uvicorn.run(app, host="0.0.0.0", port=port, workers=workers)
         return
     except ImportError:
@@ -157,24 +388,84 @@ def _run_http(port: int, paths: typing.List[str],
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status: int, payload: dict,
+                   retry_after: typing.Optional[float] = None):
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            ra = _retry_after_header(retry_after)
+            if ra is not None:
+                self.send_header("Retry-After", ra)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_POST(self):
             if self.path not in paths:
                 self.send_response(404)
                 self.end_headers()
                 return
-            length = int(self.headers.get("Content-Length", 0))
+            if "chunked" in (self.headers.get("Transfer-Encoding")
+                             or "").lower():
+                # this server never decodes chunked bodies — treating one
+                # as empty would silently ignore the client's real payload
+                # (and sail past the size cap)
+                self.close_connection = True
+                self._reply(400, {"error": "chunked request bodies are not "
+                                           "accepted", "code": "bad_request"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = -1
+            if length < 0:
+                # a negative length would make rfile.read(-N) read to EOF:
+                # a held-open connection then pins this handler thread
+                # forever and an oversized body sails past the size cap
+                self.close_connection = True
+                self._reply(400, {"error": "malformed Content-Length header",
+                                  "code": "bad_request"})
+                return
+            if max_body_bytes and length > max_body_bytes:
+                # reject before reading: an oversized body must not cost
+                # memory, parsing, or a device call
+                self.close_connection = True
+                self._reply(400, {"error": f"request body of {length} bytes "
+                                           f"exceeds serve_max_body_bytes="
+                                           f"{max_body_bytes}",
+                                  "code": "bad_request"})
+                return
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")
-                result = dispatch(self.path, body)
-                payload = json.dumps(result).encode()
-                self.send_response(200)
-            except Exception as e:  # surface errors as JSON
-                payload = json.dumps({"error": str(e)}).encode()
-                self.send_response(500)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
+            except Exception as e:
+                self._reply(400, {"error": f"malformed JSON body: {e}",
+                                  "code": "bad_request"})
+                return
+            if not isinstance(body, dict):
+                self._reply(400, {"error": "JSON object body required",
+                                  "code": "bad_request"})
+                return
+            self._dispatch_reply(body)
+
+        def do_GET(self):
+            # load balancers and k8s probe /health and /ready with GET
+            if self.path not in PROBE_PATHS or self.path not in paths:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self._dispatch_reply({})
+
+        def _dispatch_reply(self, body: dict):
+            retry_after = None
+            try:
+                status, payload = 200, dispatch(self.path, body)
+            except HTTPStatusError as e:
+                status, payload, retry_after = e.status, e.payload, e.retry_after
+            except _CLIENT_ERRORS as e:  # client error, not a server fault
+                status, payload = 400, {"error": str(e), "code": "bad_request"}
+            except Exception as e:  # genuine server fault
+                status, payload = 500, {"error": str(e), "code": "server_error"}
+            self._reply(status, payload, retry_after)
 
         def log_message(self, *a):
             pass
@@ -182,45 +473,207 @@ def _run_http(port: int, paths: typing.List[str],
     ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()
 
 
-DISPATCH_DEADLINE_S = 600.0
-
-
 def _http_child(port: int, paths: typing.List[str], requests, responses,
-                workers: int, deadline_s: float = DISPATCH_DEADLINE_S):
-    """Subprocess body: HTTP in, Manager IPC to the device loop out."""
+                workers: int, cfg: typing.Optional[dict] = None, state=None):
+    """Subprocess body: HTTP in, Manager IPC to the device loop out.
+
+    The guard decisions that must stay fast when the device loop is slow or
+    dead run HERE: edge validation (400), admission control (429), breaker
+    fast-fail (503), per-request deadline (504), and /health + /ready built
+    from the shared state dict — none of them enqueue onto the device loop.
+    """
+    import threading
+    cfg = cfg or {}
+    mono = time.monotonic
+    # fallback depth for platforms whose Queue.qsize raises (macOS):
+    # dispatches outstanding FROM THIS CHILD (queued + in decode) — close
+    # enough for the admission budget and the /ready watermark, and far
+    # better than silently disabling both by reporting 0
+    outstanding = [0]
+    outstanding_lock = threading.Lock()
+
+    def queue_depth() -> int:
+        # queued + in-decode: the device loop publishes how many requests
+        # it drained into the current decode round, so a just-drained queue
+        # doesn't read as "no pending load" to the 429 budget or /ready
+        try:
+            depth = requests.qsize()
+        except (NotImplementedError, OSError):
+            return outstanding[0]  # fallback already counts in-decode
+        if state is not None:
+            depth += int(state.get("inflight", 0) or 0)
+        return depth
+
     def dispatch(path: str, body: dict) -> dict:
+        if state is not None and path == "/health":
+            payload = child_health(state, queue_depth(), cfg)
+            if payload["status"] != "ok":
+                # stale heartbeat (serve_heartbeat_stale_s): non-200 so a
+                # status-code-only liveness probe restarts the replica
+                raise HTTPStatusError(503, payload)
+            return payload
+        if state is not None and path == "/ready":
+            ok, payload = child_ready(state, queue_depth(), cfg)
+            if not ok:
+                raise HTTPStatusError(503, payload, retry_after=1.0)
+            return payload
+        validate_request(path, body, cfg)
+        if (state is not None and path in BATCHED_PATHS
+                and state.get("breaker") == "open"):
+            ra = max(0.0, state.get("breaker_open_until", 0.0) - mono())
+            raise HTTPStatusError(
+                503, {"error": "circuit breaker open: decode is failing",
+                      "code": "unavailable"}, retry_after=ra)
+        limit = int(cfg.get("queue_limit", 0) or 0)
+        if limit and queue_depth() >= limit:
+            raise HTTPStatusError(
+                429, {"error": f"server at capacity ({limit} pending "
+                               "requests)", "code": "overloaded"},
+                retry_after=1.0)
+        deadline_s = request_deadline_s(body, cfg)
+        deadline = mono() + deadline_s
         rid = uuid.uuid4().hex
-        requests.put((rid, time.time(), path, body))
-        t0 = time.time()
-        while rid not in responses:
-            if time.time() - t0 > deadline_s:
-                raise RuntimeError("device loop did not answer within "
-                                   f"{deadline_s}s")
-            time.sleep(0.002)
-        out = responses.pop(rid)["r"]
+        with outstanding_lock:
+            outstanding[0] += 1
+        try:
+            requests.put((rid, path, body, deadline))
+            delay = 0.0
+            while True:
+                # pop-with-default: ONE Manager round-trip per poll (a
+                # membership probe + pop pair would cost two)
+                entry = responses.pop(rid, None)
+                if entry is not None:
+                    break
+                if mono() >= deadline:
+                    # the device loop writes its own 504 when it sheds the
+                    # request; an uncollected answer is pruned by the loop
+                    raise HTTPStatusError(
+                        504, {"error": f"request exceeded its {deadline_s:g}s"
+                                       " deadline", "code": "timeout"})
+                delay = poll_delay(delay)
+                time.sleep(delay)
+        finally:
+            with outstanding_lock:
+                outstanding[0] -= 1
+        out = entry["r"]
         if isinstance(out, dict) and "_error" in out:
-            raise RuntimeError(out["_error"])
+            raise HTTPStatusError(
+                out.get("_status", 500),
+                {"error": out["_error"],
+                 "code": out.get("_code", "server_error")},
+                retry_after=out.get("_retry_after"))
         return out
 
-    _run_http(port, paths, dispatch, workers)
+    _run_http(port, paths, dispatch, workers,
+              max_body_bytes=int(cfg.get("max_body_bytes", 0) or 0))
+
+
+def _process_group(handlers, interface: InterfaceWrapper,
+                   guard: typing.Optional[ServingGuard], responses,
+                   group: typing.List[tuple],
+                   clock: typing.Callable[[], float] = time.monotonic):
+    """One device-loop dispatch round: shed expired requests (504), fast-fail
+    everything while the breaker is open (503), admit a single probe while
+    half-open, then answer the rest — batched completions share ONE decode
+    call.  Invariant: every request in ``group`` gets EXACTLY ONE response
+    written into ``responses``."""
+    now = clock()
+
+    def respond(rid: str, payload: dict):
+        responses[rid] = {"t": now, "r": payload}
+
+    live = []
+    for g in group:
+        deadline = g[3] if len(g) > 3 else None
+        if deadline is not None and now >= deadline:
+            # answered, not silently dropped: the client learns immediately
+            # instead of burning the rest of its timeout
+            respond(g[0], _err(f"request expired in the queue ({g[1]})",
+                               _TIMEOUT))
+            continue
+        live.append(g)
+    if not live:
+        return
+    batchable = [g for g in live if g[1] in BATCHED_PATHS]
+    # tokenizer-only paths (/encode, /decode, in-process /health) never
+    # touch the device, so the breaker does not apply to them
+    for g in (g for g in live if g[1] not in BATCHED_PATHS):
+        rid, path, body = g[0], g[1], g[2]
+        try:
+            respond(rid, handlers[path](body))
+        except _CLIENT_ERRORS as e:
+            respond(rid, _err(e, _BAD_REQUEST))
+        except Exception as e:
+            respond(rid, _err(e, _SERVER_ERROR))
+    if not batchable:
+        return
+    breaker_state = guard.breaker.tick() if guard is not None else "closed"
+    if breaker_state == "open":
+        ra = guard.breaker.retry_after()
+        for g in batchable:
+            respond(g[0], {**_err("circuit breaker open: decode is failing",
+                                  _UNAVAILABLE), "_retry_after": ra})
+        return
+    if breaker_state == "half_open" and len(batchable) > 1:
+        # exactly ONE probe decides whether the device recovered; the rest
+        # fast-fail rather than pile onto a possibly-still-wedged device
+        for g in batchable[1:]:
+            respond(g[0], {**_err("circuit breaker half-open: probing",
+                                  _UNAVAILABLE), "_retry_after": 1.0})
+        batchable = batchable[:1]
+    if len(batchable) == 1:
+        rid, path, body = batchable[0][0], batchable[0][1], batchable[0][2]
+        try:
+            # parse first (once) so malformed input answers 400 WITHOUT
+            # touching the breaker; past this point any exception is the
+            # decode failing (a jax/numpy ValueError included) and the
+            # breaker must see it — also what lets a half-open probe always
+            # reopen or reclose
+            parsed = _parse_completion(interface, path, body)
+        except Exception as e:
+            respond(rid, _err(e, _BAD_REQUEST))
+            return
+        try:
+            out = _complete_one(interface, path, parsed)
+            if guard is not None:
+                guard.record_decode_success()
+            respond(rid, out)
+        except Exception as e:
+            if guard is not None:
+                guard.record_decode_failure()
+            respond(rid, _err(e, _SERVER_ERROR))
+    elif batchable:
+        deadlines = [g[3] if len(g) > 3 else None for g in batchable]
+        outs = _complete_batch(interface, [(g[1], g[2]) for g in batchable],
+                               deadlines=deadlines, guard=guard, clock=clock)
+        for g, out in zip(batchable, outs):
+            respond(g[0], out)
 
 
 def serve(params: ModelParameter, interface: InterfaceWrapper,
           workers: int = 1, port: int = DEFAULT_PORT, isolate: bool = True,
-          stop: typing.Optional[typing.Any] = None):
+          stop: typing.Optional[typing.Any] = None,
+          control: typing.Optional[dict] = None):
     """Blocking device loop.  ``stop`` (a ``threading.Event``-alike) makes
     shutdown clean: the loop notices it within its 1s poll, terminates the
     HTTP subprocess, and shuts the Manager down — rather than the Manager
     being GC'd out from under a live ``requests.get`` (which surfaced as an
-    EOFError traceback from the serve thread at interpreter teardown)."""
+    EOFError traceback from the serve thread at interpreter teardown).
+    ``control``, when given, is populated with live handles for tests/ops
+    (``child_pid``, ``state``)."""
     handlers = _handlers(interface)
     if not isolate:
         print(f"serving on :{port} (in-process)")
         return _run_http(port, list(handlers),
-                         lambda p, b: handlers[p](b), workers)
+                         lambda p, b: handlers[p](b), workers,
+                         max_body_bytes=int(getattr(params,
+                                                    "serve_max_body_bytes",
+                                                    0) or 0))
 
     import multiprocessing as mp
     import queue as queue_mod
+    guard = ServingGuard(params)
+    cfg = serve_config(params)
     # spawn, not fork: the parent's JAX/TPU runtime is multithreaded by now
     # and forking it can deadlock the child even though the child never
     # touches JAX.  _http_child's args are all picklable.
@@ -228,22 +681,67 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     manager = ctx.Manager()
     requests = manager.Queue()
     responses = manager.dict()
-    proc = ctx.Process(target=_http_child,
-                       args=(port, list(handlers), requests, responses,
-                             workers),
-                       daemon=True)
-    proc.start()
+    state = manager.dict()
+    try:
+        decode_path = interface.decode_path()
+    except Exception:
+        decode_path = None  # e.g. video models / stub interfaces
+    state.update(model_loaded=True, decode_path=decode_path, inflight=0)
+    guard.publish(state, interface)
+
+    def spawn_child():
+        p = ctx.Process(target=_http_child,
+                        args=(port, list(handlers), requests, responses,
+                              workers, cfg, state),
+                        daemon=True)
+        p.start()
+        if control is not None:
+            control["child_pid"] = p.pid
+            control["state"] = state
+        return p
+
+    proc = spawn_child()
     print(f"serving on :{port} (HTTP subprocess pid {proc.pid}; device loop "
           f"in main process)")
     # the device loop: strictly serialized completions in the process that
-    # owns the model.  Poll with a timeout so a dead HTTP child (e.g. the
-    # port was already bound) surfaces instead of blocking forever.  Requests
-    # older than the HTTP deadline are dropped (their client already got a
-    # 500), and answers nobody collected are pruned so the Manager dict
-    # cannot grow without bound under slow traffic.
+    # owns the model.  Poll with a timeout so a dead HTTP child surfaces;
+    # instead of killing the server, the child is relaunched with bounded
+    # exponential backoff (serve_child_max_restarts) — already-queued
+    # requests and already-written responses survive the restart.  Answers
+    # nobody collected are pruned so the Manager dict cannot grow without
+    # bound under client-side timeouts.
     batch_limit = max(1, int(getattr(params, "serve_batch_size", 1) or 1))
+    max_restarts = int(getattr(params, "serve_child_max_restarts", 5) or 0)
+    backoff = max(0.0, float(getattr(params, "serve_child_restart_backoff_s",
+                                     0.5)))
+    prune_horizon = cfg["deadline_s"] + 30.0
+    base_backoff = backoff
+    restarts = 0        # crash-loop budget: reset after a stable window
+    total_restarts = 0  # cumulative ops counter published to /health
+    child_up_since = time.monotonic()
+    # a child that has stayed up this long proved the relaunch recovered:
+    # the budget bounds crash LOOPS, not lifetime crash count — without the
+    # reset a long-lived server would die on its Nth-ever child crash
+    stability_window = 60.0
     try:
         while stop is None or not stop.is_set():
+            # heartbeat + breaker/counter mirror BEFORE blocking on the
+            # queue: /health's heartbeat age stays ~poll-period fresh when
+            # idle and grows exactly while a decode (or a wedge) runs.
+            # Same teardown guard as the queue drain below: the publish
+            # touches the Manager, which can be torn down under us
+            try:
+                guard.publish(state, interface, total_restarts)
+            except (EOFError, BrokenPipeError, ConnectionError, OSError):
+                break
+            # a relaunched child that survived the stability window proved
+            # the recovery: reset the crash-loop budget and backoff (checked
+            # every iteration — under sustained traffic the empty-poll
+            # branch below may never run)
+            if (restarts and proc.is_alive()
+                    and time.monotonic() - child_up_since > stability_window):
+                restarts = 0
+                backoff = base_backoff
             group: typing.List[tuple] = []
             try:
                 group.append(requests.get(timeout=1.0))
@@ -262,33 +760,40 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                 break
             if not group:
                 if not proc.is_alive():
-                    raise RuntimeError(
-                        f"HTTP subprocess exited (code {proc.exitcode}); "
-                        "is the port already in use?")
+                    restarts += 1
+                    total_restarts += 1
+                    if restarts > max_restarts:
+                        raise RuntimeError(
+                            f"HTTP subprocess exited (code {proc.exitcode}) "
+                            f"and {max_restarts} relaunches were exhausted; "
+                            "is the port already in use?")
+                    print(f"HTTP subprocess died (code {proc.exitcode}); "
+                          f"relaunch {restarts}/{max_restarts} in "
+                          f"{backoff:.2f}s")
+                    if stop is not None:
+                        stop.wait(backoff)  # returns early on stop.set()
+                    else:
+                        time.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
+                    if stop is not None and stop.is_set():
+                        break
+                    proc = spawn_child()
+                    child_up_since = time.monotonic()
                 continue
-            now = time.time()
-            for old_rid, entry in list(responses.items()):
-                if now - entry["t"] > DISPATCH_DEADLINE_S:
-                    responses.pop(old_rid, None)
-            live = [g for g in group if now - g[1] <= DISPATCH_DEADLINE_S]
-            batchable = [g for g in live if g[2] in BATCHED_PATHS]
-            for rid, _, path, body in (g for g in live
-                                       if g[2] not in BATCHED_PATHS):
-                try:
-                    responses[rid] = {"t": now, "r": handlers[path](body)}
-                except Exception as e:
-                    responses[rid] = {"t": now, "r": {"_error": str(e)}}
-            if len(batchable) == 1:
-                rid, _, path, body = batchable[0]
-                try:
-                    responses[rid] = {"t": now, "r": handlers[path](body)}
-                except Exception as e:
-                    responses[rid] = {"t": now, "r": {"_error": str(e)}}
-            elif batchable:
-                outs = _complete_batch(interface,
-                                       [(g[2], g[3]) for g in batchable])
-                for (rid, *_), out in zip(batchable, outs):
-                    responses[rid] = {"t": now, "r": out}
+            try:
+                now = time.monotonic()
+                for old_rid, entry in list(responses.items()):
+                    if now - entry["t"] > prune_horizon:
+                        responses.pop(old_rid, None)
+                # drained-but-decoding requests still occupy the admission
+                # budget: the child adds this to qsize for 429 and /ready
+                state["inflight"] = len(group)
+                # decode errors are answered inside _process_group; only a
+                # Manager teardown mid-respond can raise out of it
+                _process_group(handlers, interface, guard, responses, group)
+                state["inflight"] = 0
+            except (EOFError, BrokenPipeError, ConnectionError, OSError):
+                break
     finally:
         proc.terminate()
         proc.join(timeout=5.0)

@@ -1,0 +1,296 @@
+"""Serving reliability layer (docs/RELIABILITY.md 'Serving').
+
+PR 1 gave *training* an engineered failure story; this module gives the
+REST serving path the same treatment, as five cooperating mechanisms used by
+``infer/rest_api.py``:
+
+1. **Admission control** — a bounded pending-request budget
+   (``serve_queue_limit``): when the IPC queue is full the HTTP child
+   answers 429 + ``Retry-After`` immediately instead of enqueueing, and
+   ``validate_request`` rejects oversized/overlong/miscapped requests with
+   400 at the HTTP edge, before they cost a device call.
+2. **Per-request deadlines** — clients may pass ``timeout_s`` (capped by
+   ``serve_request_deadline_s``); the deadline rides the request tuple into
+   batch assembly, expired requests are shed *and answered* with 504, and
+   the child's own poll gives up at the same deadline — no accepted request
+   ever goes unanswered.
+3. **Circuit breaker** — ``CircuitBreaker``: after
+   ``serve_breaker_threshold`` consecutive decode failures requests
+   fast-fail with 503 + ``Retry-After`` for ``serve_breaker_cooldown_s``,
+   then a single probe half-opens it.  The device loop owns the breaker;
+   its state is mirrored into shared IPC state so the HTTP child fast-fails
+   without touching the device loop.
+4. **Supervision + liveness** — the device loop heartbeats into shared
+   state every poll; ``child_health``/``child_ready`` build the
+   ``/health``/``/ready`` payloads in the HTTP child directly, so health
+   checks answer even when the device loop is wedged in a decode.
+5. **Fault injection** — ``utils.fault_injection.FaultyInterface`` drives
+   all of the above deterministically in tests/serving_robustness_test.py.
+
+Deliberately dependency-light (stdlib only): everything here must be
+importable from the spawned HTTP child subprocess without touching jax.
+
+Clock discipline: all elapsed-time arithmetic uses ``time.monotonic()``.
+Deadlines DO cross the child->device-loop process boundary, which is safe
+because both processes live on one host and CLOCK_MONOTONIC is system-wide
+on every platform we serve from (Linux; also macOS/Windows equivalents).
+"""
+from __future__ import annotations
+
+import time
+import typing
+
+
+class HTTPStatusError(Exception):
+    """A response with an explicit HTTP status (and optional Retry-After),
+    raised by dispatch/validation and rendered by the HTTP server layer."""
+
+    def __init__(self, status: int, payload: typing.Dict[str, typing.Any],
+                 retry_after: typing.Optional[float] = None):
+        super().__init__(payload.get("error", str(status)))
+        self.status = int(status)
+        self.payload = payload
+        self.retry_after = retry_after
+
+
+def _bad_request(msg: str) -> typing.NoReturn:
+    raise HTTPStatusError(400, {"error": msg, "code": "bad_request"})
+
+
+def serve_config(params) -> typing.Dict[str, typing.Any]:
+    """The serving knobs as a plain picklable dict — the HTTP child
+    subprocess gets this instead of the full ModelParameter (which carries
+    jnp dtypes and derived Dim objects it must never import)."""
+    seq = (int(getattr(params, "sequence_length", 0))
+           // max(1, int(getattr(params, "token_patch_size", 1) or 1)))
+    return {
+        "queue_limit": int(getattr(params, "serve_queue_limit", 64) or 0),
+        "deadline_s": float(getattr(params, "serve_request_deadline_s", 120.0)),
+        "max_body_bytes": int(getattr(params, "serve_max_body_bytes", 1 << 20) or 0),
+        # 0 = cap off: over-asks clamp to the sequence like they always
+        # did (rejecting them at the default config would break existing
+        # clients that expect server-side clamping)
+        "max_response_tokens": int(getattr(params, "serve_max_response_tokens",
+                                           0) or 0),
+        "seq_tokens": seq,
+        "vocab_size": int(getattr(params, "vocab_size", 256)),
+        "serve_batch_size": int(getattr(params, "serve_batch_size", 1) or 1),
+        "hb_stale_s": float(getattr(params, "serve_heartbeat_stale_s", 0.0)
+                            or 0.0),
+    }
+
+
+def validate_request(path: str, body, cfg: typing.Dict[str, typing.Any]):
+    """Reject requests that cannot possibly succeed with 400 at the HTTP
+    edge, before they cost an IPC round-trip and a device call: non-object
+    bodies, prompts past the sequence capacity, ``max_tokens`` above the
+    server cap, and malformed ``timeout_s``.
+
+    /completion prompt length is only checkable here for the byte-level
+    tokenizer (vocab <= 256: one token per UTF-8 byte); BPE prompts are
+    still truncation-flagged by the device loop (satellite: ``truncated``)."""
+    if not isinstance(body, dict):
+        _bad_request("JSON object body required")
+    seq = int(cfg.get("seq_tokens", 0) or 0)
+    if path == "/token_completion":
+        toks = body.get("tokens", [])
+        if not isinstance(toks, (list, tuple)):
+            _bad_request("tokens must be a list of ints")
+        if seq and len(toks) > seq:
+            _bad_request(f"prompt of {len(toks)} tokens exceeds the "
+                         f"{seq}-token sequence capacity")
+    if path in ("/completion", "/encode"):
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str):
+            _bad_request("prompt must be a string")
+    if path == "/completion":
+        prompt = body.get("prompt", "")
+        if seq and int(cfg.get("vocab_size", 257)) <= 256:
+            n = len(prompt.encode("utf-8", "replace"))
+            if n > seq:
+                _bad_request(f"prompt of {n} byte-tokens exceeds the "
+                             f"{seq}-token sequence capacity")
+    if path in ("/completion", "/token_completion"):
+        mt = body.get("max_tokens")
+        if mt is not None:
+            try:
+                mt = int(mt)
+            except (TypeError, ValueError, OverflowError):
+                # OverflowError: json.loads accepts the Infinity literal,
+                # and int(float('inf')) overflows — still a client error
+                _bad_request(f"max_tokens must be an int, got {mt!r}")
+            if mt < 0:
+                _bad_request(f"max_tokens must be >= 0, got {mt}")
+            cap = int(cfg.get("max_response_tokens", 0) or 0)
+            if cap and mt > cap:
+                _bad_request(f"max_tokens={mt} above the server cap of {cap}")
+    ts = body.get("timeout_s")
+    if ts is not None:
+        try:
+            ts = float(ts)
+        except (TypeError, ValueError):
+            _bad_request(f"timeout_s must be a number, got {ts!r}")
+        if ts <= 0:
+            _bad_request(f"timeout_s must be > 0, got {ts}")
+
+
+def request_deadline_s(body, cfg: typing.Dict[str, typing.Any]) -> float:
+    """Effective per-request deadline: the client's ``timeout_s`` capped by
+    ``serve_request_deadline_s`` (which is also the default)."""
+    cap = float(cfg.get("deadline_s", 120.0))
+    ts = body.get("timeout_s") if isinstance(body, dict) else None
+    if ts is None:
+        return cap
+    try:
+        ts = float(ts)
+    except (TypeError, ValueError):
+        return cap
+    return min(ts, cap) if ts > 0 else cap
+
+
+def poll_delay(delay: float, start: float = 0.002, ceiling: float = 0.05,
+               growth: float = 1.5) -> float:
+    """Adaptive response-poll backoff: each Manager-dict membership probe is
+    an IPC round-trip to the Manager process, so N slow concurrent requests
+    polling at a fixed 2 ms hammer it with 500*N probes/sec.  Start at 2 ms
+    (snappy fast requests) and grow toward ~50 ms (cheap slow ones)."""
+    return min(max(delay, start) * growth, ceiling)
+
+
+class CircuitBreaker:
+    """closed -> open after ``threshold`` CONSECUTIVE decode failures; while
+    open, requests fast-fail (503) for ``cooldown_s``; then ``tick()`` moves
+    to half_open, where a single probe request decides: success recloses,
+    failure reopens for another cooldown.  ``threshold <= 0`` disables the
+    breaker entirely (always closed).  The clock is injectable so tests
+    drive the full cycle with zero wall-clock sleeps."""
+
+    def __init__(self, threshold: int, cooldown_s: float,
+                 clock: typing.Callable[[], float] = time.monotonic):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self.state = "closed"
+        self.failures = 0       # consecutive decode failures
+        self.open_until = 0.0
+        self.opened = 0         # times the breaker has tripped (ops counter)
+
+    def tick(self) -> str:
+        if self.state == "open" and self.clock() >= self.open_until:
+            self.state = "half_open"
+        return self.state
+
+    def record_failure(self):
+        if self.threshold <= 0:
+            return
+        self.failures += 1
+        if self.state == "open":
+            # already open (e.g. per-row retries of the batch that tripped
+            # it): re-tripping would inflate the `opened` ops counter and
+            # restart the cooldown from the last straggler failure
+            return
+        if self.state == "half_open" or self.failures >= self.threshold:
+            self.state = "open"
+            self.open_until = self.clock() + self.cooldown_s
+            self.opened += 1
+
+    def record_success(self):
+        self.failures = 0
+        if self.state != "closed":
+            # a successful probe (or a straggler decode finishing cleanly
+            # after the trip) is direct evidence the device is healthy again
+            self.state = "closed"
+
+    def retry_after(self) -> float:
+        return max(0.0, self.open_until - self.clock())
+
+
+class ServingGuard:
+    """Device-loop-side reliability state: the breaker plus the decode
+    failure counter it reads, and the publisher that mirrors both — with a
+    liveness heartbeat — into the shared IPC state the HTTP child serves
+    ``/health``/``/ready`` and fast-fail decisions from."""
+
+    def __init__(self, params=None, threshold: typing.Optional[int] = None,
+                 cooldown_s: typing.Optional[float] = None,
+                 clock: typing.Callable[[], float] = time.monotonic):
+        if threshold is None:
+            threshold = int(getattr(params, "serve_breaker_threshold", 0) or 0)
+        if cooldown_s is None:
+            cooldown_s = float(getattr(params, "serve_breaker_cooldown_s", 30.0))
+        self.breaker = CircuitBreaker(threshold, cooldown_s, clock)
+        self.clock = clock
+        self.decode_failures = 0
+
+    def record_decode_success(self):
+        self.breaker.record_success()
+
+    def record_decode_failure(self):
+        self.decode_failures += 1
+        self.breaker.record_failure()
+
+    def publish(self, state, interface=None, restarts: int = 0):
+        # one .update call = one IPC round-trip (per-key assignment would be
+        # one each); runs once per device-loop poll
+        state.update(hb=self.clock(),
+                     breaker=self.breaker.tick(),
+                     breaker_open_until=self.breaker.open_until,
+                     breaker_trips=self.breaker.opened,
+                     decode_failures=self.decode_failures,
+                     decode_calls=int(getattr(interface, "decode_calls", 0) or 0),
+                     child_restarts=int(restarts))
+
+
+def child_health(state, queue_depth: int, cfg: typing.Dict[str, typing.Any],
+                 clock: typing.Callable[[], float] = time.monotonic) -> dict:
+    """Liveness payload, built ENTIRELY from shared state + the queue proxy:
+    answering must never cross the device loop, or health checks block
+    exactly when the server is sick.
+
+    With ``serve_heartbeat_stale_s`` > 0, a heartbeat older than the
+    threshold flips ``status`` to "stale" (served as HTTP 503) so an
+    orchestrator's status-code-only liveness probe restarts a permanently
+    wedged device loop.  Off by default: a legitimately long decode also
+    ages the heartbeat, so the operator must pick a threshold above their
+    worst-case decode."""
+    hb = state.get("hb")
+    age = round(clock() - hb, 3) if hb is not None else None
+    stale_after = float(cfg.get("hb_stale_s", 0) or 0)
+    stale = stale_after > 0 and age is not None and age > stale_after
+    return {"status": "stale" if stale else "ok",
+            "heartbeat_age_s": age,
+            "breaker": state.get("breaker", "closed"),
+            "queue_depth": int(queue_depth),
+            "decode_calls": int(state.get("decode_calls", 0) or 0),
+            "decode_failures": int(state.get("decode_failures", 0) or 0),
+            "breaker_trips": int(state.get("breaker_trips", 0) or 0),
+            "child_restarts": int(state.get("child_restarts", 0) or 0),
+            "serve_batch_size": int(cfg.get("serve_batch_size", 1)),
+            "decode_path": state.get("decode_path")}
+
+
+def child_ready(state, queue_depth: int, cfg: typing.Dict[str, typing.Any]
+                ) -> typing.Tuple[bool, dict]:
+    """Readiness: model loaded AND breaker not open AND queue below the
+    watermark (``serve_queue_limit``).  Distinct from /health: a load
+    balancer drains a not-ready replica but does not restart it.
+
+    half_open deliberately reports READY: reclosing requires a real
+    completion request to serve as the probe, and a readiness-honoring load
+    balancer would otherwise never route one — leaving the replica drained
+    forever after the device recovered."""
+    reasons = []
+    if not state.get("model_loaded"):
+        reasons.append("model not loaded")
+    breaker = state.get("breaker", "closed")
+    if breaker == "open":
+        reasons.append("circuit breaker open")
+    watermark = int(cfg.get("queue_limit", 0) or 0)
+    if watermark and queue_depth >= watermark:
+        reasons.append(f"queue depth {queue_depth} at/above the "
+                       f"{watermark}-request watermark")
+    payload = {"ready": not reasons, "breaker": breaker,
+               "queue_depth": int(queue_depth)}
+    if reasons:
+        payload["reasons"] = reasons
+    return not reasons, payload

@@ -138,9 +138,35 @@ def web_api_mode(params: ModelParameter, args):
     params, model, variables, mesh = _load_model(params)
     interface = InterfaceWrapper(params, model, variables, mesh=mesh)
     from ..infer.rest_api import serve
-    # reference: web_workers uvicorn processes (src/rest_api.py:84-87);
-    # main.py has already folded CLI --workers into params.web_workers
-    serve(params, interface, workers=params.web_workers)
+    # preemption-safe serving shutdown, mirroring the train loop's handlers:
+    # SIGTERM/SIGINT set a stop event the device loop notices within its 1s
+    # poll, so the HTTP subprocess and the IPC Manager are torn down cleanly
+    # (in-flight responses are answered; no EOFError traceback at teardown)
+    import signal
+    import threading
+    from .train_loop import _ShutdownFlag
+    stop = threading.Event()
+    # the train loop's handler object: one shared implementation of the
+    # reentrancy-safe message write and the repeated-signal force-exit
+    # (needed when the device loop is wedged inside a decode and never
+    # reaches its stop-event poll)
+    handler = _ShutdownFlag(
+        message="draining the serve loop (repeat to force-exit)",
+        on_signal=stop.set)
+    previous = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            previous[sig] = signal.signal(sig, handler)
+        except ValueError:  # not the main thread (embedded use) — skip
+            pass
+    try:
+        # reference: web_workers uvicorn processes (src/rest_api.py:84-87);
+        # main.py has already folded CLI --workers into params.web_workers
+        serve(params, interface, workers=params.web_workers, stop=stop)
+    finally:
+        for sig, prev in previous.items():
+            if prev is not None:  # None = installed by non-Python code;
+                signal.signal(sig, prev)  # signal() rejects it
 
 
 def debug_mode(params: ModelParameter, args):

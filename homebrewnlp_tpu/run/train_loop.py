@@ -48,11 +48,21 @@ class NonFiniteLossError(RuntimeError):
 class _ShutdownFlag:
     """SIGTERM/SIGINT handler: request a graceful stop.  The loop finishes
     the in-flight step, then the finally path writes the emergency
-    checkpoint and rewrites the run log — the run exits resumable."""
+    checkpoint and rewrites the run log — the run exits resumable.
 
-    def __init__(self):
+    Reused by the serving path (run/modes.py web_api_mode) with a custom
+    ``message`` and an ``on_signal`` callback (an Event's ``set``), so the
+    second-signal force-exit and reentrancy-safe write protocol live in ONE
+    place."""
+
+    def __init__(self, message: typing.Optional[str] = None,
+                 on_signal: typing.Optional[typing.Callable[[], None]] = None):
         self.requested = False
         self.signum: typing.Optional[int] = None
+        self.message = message or ("finishing the in-flight step, then "
+                                   "writing an emergency checkpoint "
+                                   "(repeat to force-exit)")
+        self.on_signal = on_signal
 
     def __call__(self, signum, frame):
         if self.requested:
@@ -64,14 +74,14 @@ class _ShutdownFlag:
             return
         self.requested = True
         self.signum = signum
+        if self.on_signal is not None:
+            self.on_signal()
         # os.write, not print: a signal landing mid-print would make
         # buffered stdout raise "reentrant call" in the main thread, turning
         # the graceful path into a crash
         try:
             os.write(2, (f"received {signal.Signals(signum).name}: "
-                         "finishing the in-flight step, then writing an "
-                         "emergency checkpoint (repeat to force-exit)\n"
-                         ).encode())
+                         f"{self.message}\n").encode())
         except OSError:
             pass
 

@@ -93,3 +93,70 @@ class FaultInjectionFS(fslib._ObjectStoreFS):
     def _delete(self, key):
         self._before("delete", key)
         self.inner._delete(key)
+
+
+class FaultyInterface:
+    """Deterministic SERVING fault injection: wraps an
+    ``infer.interface.InterfaceWrapper`` (or any interface-alike) and
+    injects faults at decode-call granularity, keyed on a monotonically
+    increasing CALL INDEX shared across ``complete`` / ``complete_tokens`` /
+    ``complete_tokens_batch`` — the serving analogue of
+    ``FaultInjectionFS``'s op-index schedules:
+
+    * ``fail_at = {K, ...}`` (or ``{K: "msg"}``): the K-th decode call
+      raises ``InjectedFault`` — a crashing/poisoned decode.
+    * ``latency = {K: seconds}``: the K-th decode call sleeps first — a
+      slow decode that expires the deadlines of everything queued behind it.
+    * ``block_on = threading.Event()`` (optionally ``block_at = {K, ...}``;
+      default ALL calls): the matching decode calls wait until the event is
+      SET — a wedged device loop, released by the test.  ``block_timeout_s``
+      bounds the wait so a broken test cannot hang the suite.
+
+    Attribute access proxies to the wrapped interface (``tokenizer``,
+    ``params``, ``decode_calls``, ...), so the REST stack runs against it
+    unchanged (tests/serving_robustness_test.py, marker: ``serving``).
+    ``calls`` records how many decode calls were issued."""
+
+    def __init__(self, inner,
+                 fail_at: typing.Union[typing.Dict[int, str],
+                                       typing.Iterable[int]] = (),
+                 latency: typing.Optional[typing.Dict[int, float]] = None,
+                 block_on=None,
+                 block_at: typing.Optional[typing.Iterable[int]] = None,
+                 block_timeout_s: float = 60.0):
+        self._inner = inner
+        self.fail_at = (dict(fail_at) if isinstance(fail_at, dict)
+                        else {k: None for k in fail_at})
+        self.latency = dict(latency or {})
+        self.block_on = block_on
+        self.block_at = None if block_at is None else set(block_at)
+        self.block_timeout_s = block_timeout_s
+        self.calls = 0
+
+    def _gate(self):
+        import time
+        i = self.calls
+        self.calls += 1
+        if self.block_on is not None and (self.block_at is None
+                                          or i in self.block_at):
+            self.block_on.wait(timeout=self.block_timeout_s)
+        if i in self.latency:
+            time.sleep(self.latency[i])
+        if i in self.fail_at:
+            raise InjectedFault(self.fail_at[i]
+                                or f"injected decode failure at call {i}")
+
+    def complete_tokens(self, *args, **kwargs):
+        self._gate()
+        return self._inner.complete_tokens(*args, **kwargs)
+
+    def complete_tokens_batch(self, *args, **kwargs):
+        self._gate()
+        return self._inner.complete_tokens_batch(*args, **kwargs)
+
+    def complete(self, *args, **kwargs):
+        self._gate()
+        return self._inner.complete(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

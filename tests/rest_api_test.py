@@ -74,17 +74,19 @@ def isolated_serving_test():
     assert out["tokens"] == [104, 105]
     out = post("/token_completion", {"tokens": [1, 2, 3], "temperature": 0.0})
     assert len(out["tokens"]) == 16
-    # errors surface as HTTP 500 JSON, not a wedged device loop
+    # client errors surface as HTTP 400 JSON (rejected at the HTTP edge,
+    # before costing a device call), not a wedged device loop
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/token_completion",
         data=json.dumps({"tokens": "bogus"}).encode(),
         headers={"Content-Type": "application/json"})
     try:
         urllib.request.urlopen(req, timeout=60)
-        raise AssertionError("expected HTTP 500")
+        raise AssertionError("expected HTTP 400")
     except urllib.error.HTTPError as e:
-        assert e.code == 500
-        assert "error" in json.loads(e.read())
+        assert e.code == 400
+        body = json.loads(e.read())
+        assert "error" in body and body.get("code") == "bad_request"
     # and the loop still answers afterwards
     assert post("/decode", {"tokens": [104, 105]})["prompt"] == "hi"
     # clean shutdown: the loop notices the stop event within its poll and

@@ -1,0 +1,626 @@
+"""Serving-path fault tolerance (marker: serving; docs/RELIABILITY.md
+'Serving').
+
+Unit sweep: breaker state machine, HTTP-edge validation, truncation
+surfacing, per-row batch isolation, deadline shedding with the
+exactly-one-answer invariant, and a no-fault smoke test pinning the guarded
+path byte-identical to a direct handler call.
+
+Integration sweep (real spawn subprocess + Manager IPC, stub decode so no
+device work): /health + /ready answering from the HTTP child while the
+device loop is wedged in a decode, 429 under queue pressure, 504 on expiry,
+the breaker open -> fast-fail -> probe -> reclose cycle, and survival of a
+SIGKILLed HTTP child mid-traffic.  All device-free (tier-1 on CPU)."""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from backend import make_params
+from homebrewnlp_tpu.infer import rest_api, serving_guard
+from homebrewnlp_tpu.infer.interface import Tokenizer
+from homebrewnlp_tpu.utils.fault_injection import FaultyInterface
+
+pytestmark = pytest.mark.serving
+
+
+def _serve_params(**kw):
+    cfg = dict(vocab_size=256, serve_batch_size=4, serve_queue_limit=8,
+               serve_request_deadline_s=10.0, serve_breaker_threshold=3,
+               serve_breaker_cooldown_s=0.5, serve_child_max_restarts=3,
+               serve_child_restart_backoff_s=0.1)
+    cfg.update(kw)
+    return make_params(**cfg)
+
+
+class _StubInterface:
+    """Interface-shaped stub with a deterministic, device-free 'decode' so
+    the serving stack's control flow is testable in milliseconds."""
+
+    def __init__(self, params):
+        self.params = params
+        self.tokenizer = Tokenizer(params)
+        self.decode_calls = 0
+
+    @property
+    def prompt_capacity(self):
+        return self.params.sequence_length // self.params.token_patch_size - 1
+
+    def _one(self, tokens, response_len):
+        seq = self.params.sequence_length // self.params.token_patch_size
+        toks = np.asarray(tokens, np.int32).reshape(-1)[:seq - 1]
+        end = seq if response_len is None else min(seq,
+                                                   len(toks) + int(response_len))
+        out = np.zeros(end, np.int32)
+        out[:len(toks)] = toks
+        out[len(toks):] = np.arange(end - len(toks))
+        return out
+
+    def complete_tokens(self, tokens, temperature=0.0, response_len=None,
+                        seed=0, top_k=None, top_p=None,
+                        repetition_penalty=None):
+        self.decode_calls += 1
+        return self._one(tokens, response_len)
+
+    def complete_tokens_batch(self, token_lists, temperatures=None,
+                              response_lens=None, seed=0, top_ks=None,
+                              top_ps=None, rep_penalties=None):
+        self.decode_calls += 1
+        rls = response_lens or [None] * len(token_lists)
+        return [self._one(t, rl) for t, rl in zip(token_lists, rls)]
+
+    def complete(self, query, temperature=0.0, response_len=None, seed=0,
+                 top_k=None, top_p=None, repetition_penalty=None):
+        toks = self.tokenizer.encode(query)
+        out = self.complete_tokens(toks, temperature, response_len, seed)
+        return self.tokenizer.decode(out[min(len(toks), self.prompt_capacity):])
+
+    def decode_path(self, width=None):
+        return {"loop": "stub"}
+
+
+# ---------------------------------------------------------------- unit sweep
+
+def breaker_state_machine_test():
+    t = [0.0]
+    brk = serving_guard.CircuitBreaker(threshold=3, cooldown_s=10.0,
+                                       clock=lambda: t[0])
+    assert brk.tick() == "closed"
+    brk.record_failure()
+    brk.record_failure()
+    assert brk.state == "closed"      # below threshold
+    brk.record_success()              # success resets the CONSECUTIVE count
+    brk.record_failure()
+    brk.record_failure()
+    assert brk.state == "closed"
+    brk.record_failure()
+    assert brk.state == "open" and brk.opened == 1
+    assert brk.retry_after() == 10.0
+    brk.record_failure()              # straggler failures while open (e.g.
+    assert brk.opened == 1            # per-row retries) don't re-trip or
+    assert brk.open_until == 10.0     # extend the cooldown
+    t[0] = 9.9
+    assert brk.tick() == "open"
+    t[0] = 10.0
+    assert brk.tick() == "half_open"
+    brk.record_failure()              # failed probe reopens, fresh cooldown
+    assert brk.state == "open" and brk.open_until == 20.0 and brk.opened == 2
+    t[0] = 20.0
+    assert brk.tick() == "half_open"
+    brk.record_success()              # successful probe recloses
+    assert brk.state == "closed" and brk.failures == 0
+    off = serving_guard.CircuitBreaker(0, 1.0, clock=lambda: t[0])
+    for _ in range(10):
+        off.record_failure()
+    assert off.tick() == "closed"     # threshold 0 = breaker disabled
+
+
+def edge_validation_test():
+    cfg = serving_guard.serve_config(_serve_params())
+    assert cfg["seq_tokens"] == 16 and cfg["max_response_tokens"] == 0
+
+    def rejected(path, body):
+        try:
+            serving_guard.validate_request(path, body, cfg)
+            return False
+        except serving_guard.HTTPStatusError as e:
+            assert e.status == 400 and e.payload["code"] == "bad_request"
+            return True
+
+    assert rejected("/completion", [])                      # non-object body
+    assert rejected("/token_completion", {"tokens": "bogus"})
+    assert rejected("/token_completion", {"tokens": list(range(17))})
+    serving_guard.validate_request("/token_completion",
+                                   {"tokens": list(range(16))}, cfg)
+    assert rejected("/completion", {"prompt": "x" * 17})    # byte-level vocab
+    assert rejected("/completion", {"prompt": 7})
+    # default cap 0 = off: over-asks clamp later instead of rejecting
+    serving_guard.validate_request("/completion",
+                                   {"prompt": "a", "max_tokens": 99}, cfg)
+    capped = serving_guard.serve_config(
+        _serve_params(serve_max_response_tokens=8))
+    try:
+        serving_guard.validate_request(
+            "/completion", {"prompt": "a", "max_tokens": 99}, capped)
+        raise AssertionError("expected 400 above the configured cap")
+    except serving_guard.HTTPStatusError as e:
+        assert e.status == 400
+    assert rejected("/completion", {"prompt": "a", "max_tokens": -1})
+    assert rejected("/completion", {"prompt": "a", "max_tokens": "lots"})
+    assert rejected("/completion", {"prompt": "a",
+                                    "max_tokens": float("inf")})
+    serving_guard.validate_request("/completion",
+                                   {"prompt": "a", "max_tokens": 5}, cfg)
+    assert rejected("/encode", {"prompt": "a", "timeout_s": 0})
+    assert rejected("/encode", {"prompt": "a", "timeout_s": "soon"})
+    serving_guard.validate_request("/encode",
+                                   {"prompt": "a", "timeout_s": 2.5}, cfg)
+    # client timeout_s is honored below the cap, capped above it
+    assert serving_guard.request_deadline_s({"timeout_s": 3}, cfg) == 3.0
+    assert serving_guard.request_deadline_s({"timeout_s": 1e9}, cfg) == 10.0
+    assert serving_guard.request_deadline_s({}, cfg) == 10.0
+
+
+def child_probe_payload_test():
+    """Pure-function /health + /ready semantics: half_open reports READY
+    (probe traffic must reach the breaker to reclose it), open does not;
+    /health flips to 'stale' past the opt-in heartbeat-age threshold."""
+    cfg = serving_guard.serve_config(_serve_params())
+    state = {"hb": 100.0, "model_loaded": True, "breaker": "half_open"}
+    ok, payload = serving_guard.child_ready(state, 0, cfg)
+    assert ok and payload["ready"] is True
+    state["breaker"] = "open"
+    ok, payload = serving_guard.child_ready(state, 0, cfg)
+    assert not ok and payload["reasons"] == ["circuit breaker open"]
+    # heartbeat staleness: off by default, 503-shaped "stale" when enabled
+    h = serving_guard.child_health(state, 0, cfg, clock=lambda: 1000.0)
+    assert h["status"] == "ok" and h["heartbeat_age_s"] == 900.0
+    cfg2 = serving_guard.serve_config(
+        _serve_params(serve_heartbeat_stale_s=30.0))
+    assert serving_guard.child_health(state, 0, cfg2,
+                                      clock=lambda: 1000.0
+                                      )["status"] == "stale"
+    assert serving_guard.child_health(state, 0, cfg2,
+                                      clock=lambda: 120.0
+                                      )["status"] == "ok"
+
+
+def poll_backoff_test():
+    delays = []
+    d = 0.0
+    for _ in range(12):
+        d = serving_guard.poll_delay(d)
+        delays.append(d)
+    assert delays[0] == pytest.approx(0.003)   # starts near 2 ms
+    assert delays[-1] == 0.05                  # grows to the 50 ms ceiling
+    assert all(b >= a for a, b in zip(delays, delays[1:]))
+
+
+def truncated_prompt_flag_test():
+    stub = _StubInterface(_serve_params())
+    handlers = rest_api._handlers(stub)
+    out = handlers["/token_completion"]({"tokens": list(range(16))})
+    assert out["truncated"] is True and out["prompt_tokens_kept"] == 15
+    out = handlers["/token_completion"]({"tokens": [1, 2, 3]})
+    assert "truncated" not in out and "prompt_tokens_kept" not in out
+    out = handlers["/completion"]({"prompt": "x" * 16})
+    assert out["truncated"] is True and out["prompt_tokens_kept"] == 15
+    assert "truncated" not in handlers["/completion"]({"prompt": "hi"})
+    outs = rest_api._complete_batch(stub, [
+        ("/token_completion", {"tokens": list(range(16))}),
+        ("/token_completion", {"tokens": [5]})])
+    assert outs[0]["truncated"] is True and outs[0]["prompt_tokens_kept"] == 15
+    assert "truncated" not in outs[1]
+
+
+def response_cap_bounds_default_decode_test():
+    """serve_max_response_tokens bounds EVERY completion's decode length —
+    including requests that omit max_tokens (or send 0), which previously
+    meant 'decode the full sequence'."""
+    stub = _StubInterface(_serve_params(serve_max_response_tokens=4))
+    handlers = rest_api._handlers(stub)
+    out = handlers["/token_completion"]({"tokens": [1, 2]})
+    assert len(out["tokens"]) == 6          # 2 prompt + 4 capped generation
+    out = handlers["/token_completion"]({"tokens": [1, 2], "max_tokens": 0})
+    assert len(out["tokens"]) == 6
+    out = handlers["/token_completion"]({"tokens": [1, 2], "max_tokens": 3})
+    assert len(out["tokens"]) == 5          # explicit below the cap wins
+    # cap off (default): full sequence, unchanged
+    stub = _StubInterface(_serve_params())
+    out = rest_api._handlers(stub)["/token_completion"]({"tokens": [1, 2]})
+    assert len(out["tokens"]) == 16
+
+
+def batch_parse_misalignment_test():
+    """A row rejected mid-parse (bad filter AFTER its tokens were read) must
+    not shift its neighbors onto the wrong prompts: each surviving row
+    decodes its OWN prompt."""
+    stub = _StubInterface(_serve_params())
+    outs = rest_api._complete_batch(stub, [
+        ("/token_completion", {"tokens": [9, 9], "repetition_penalty": 0}),
+        ("/token_completion", {"tokens": [1, 2, 3]})])
+    assert outs[0]["_status"] == 400 and "_error" in outs[0]
+    assert outs[1]["tokens"][:3] == [1, 2, 3]
+
+
+def batch_row_isolation_test():
+    """A failed batch decode retries per row: the poisoned request fails
+    alone (500), its co-batched neighbors still get real answers, and the
+    breaker's failure counter records the events."""
+    params = _serve_params()
+    faulty = FaultyInterface(_StubInterface(params), fail_at={0, 2})
+    guard = serving_guard.ServingGuard(params)
+    items = [("/token_completion", {"tokens": [1, 2]}),
+             ("/token_completion", {"tokens": [3]}),
+             ("/token_completion", {"tokens": [4, 5, 6]})]
+    # call 0 fails the whole batch; calls 1..3 are the per-row retries with
+    # the middle row (call 2) poisoned
+    outs = rest_api._complete_batch(faulty, items, guard=guard)
+    assert outs[0]["tokens"][:2] == [1, 2]
+    assert outs[1].get("_status") == 500 and "_error" in outs[1]
+    assert outs[2]["tokens"][:3] == [4, 5, 6]
+    assert guard.decode_failures == 2   # the batch event + the poisoned row
+    assert guard.breaker.state == "closed"  # row successes reset the streak
+
+
+def process_group_deadline_and_answer_test():
+    """Expired requests are shed AND answered (504); every request in the
+    group gets exactly one response."""
+    stub = _StubInterface(_serve_params())
+    handlers = rest_api._handlers(stub)
+    guard = serving_guard.ServingGuard(stub.params)
+    responses = {}
+    now = time.monotonic()
+    group = [("expired", "/token_completion", {"tokens": [1]}, now - 1),
+             ("live", "/token_completion", {"tokens": [2]}, now + 60),
+             ("enc", "/encode", {"prompt": "hi"}, now + 60)]
+    rest_api._process_group(handlers, stub, guard, responses, group)
+    assert set(responses) == {"expired", "live", "enc"}
+    assert responses["expired"]["r"]["_status"] == 504
+    assert responses["expired"]["r"]["_code"] == "timeout"
+    assert responses["live"]["r"]["tokens"][0] == 2
+    assert responses["enc"]["r"]["tokens"] == [104, 105]
+    assert stub.decode_calls == 1       # the expired request cost no decode
+    # malformed-but-valid-JSON element values (np parse TypeError) are
+    # client errors: 400, and NEVER counted toward the breaker
+    rest_api._process_group(handlers, stub, guard, responses,
+                            [("bad", "/token_completion",
+                              {"tokens": [None]}, now + 60)])
+    assert responses["bad"]["r"]["_status"] == 400
+    assert guard.decode_failures == 0
+    assert guard.breaker.state == "closed"
+
+
+def single_path_decode_error_classification_test():
+    """Single-request path: malformed input answers 400 without touching
+    the breaker, but a decode-side exception — even a ValueError — is a
+    server fault (500) the breaker must see."""
+    params = _serve_params(serve_breaker_threshold=1)
+    stub = _StubInterface(params)
+
+    def bad_decode(*a, **k):
+        raise ValueError("device-side shape mismatch")
+
+    stub.complete_tokens = bad_decode
+    handlers = rest_api._handlers(stub)
+    guard = serving_guard.ServingGuard(params)
+    responses = {}
+    now = time.monotonic()
+    rest_api._process_group(handlers, stub, guard, responses,
+                            [("ok-parse", "/token_completion",
+                              {"tokens": [1]}, now + 60)])
+    assert responses["ok-parse"]["r"]["_status"] == 500
+    assert guard.decode_failures == 1 and guard.breaker.state == "open"
+
+
+def breaker_shed_and_probe_test():
+    """Driven entirely by a fake clock: the breaker opens at the threshold,
+    open sheds with 503 + retry-after without touching decode, half-open
+    admits exactly ONE probe, and a successful probe recloses."""
+    params = _serve_params(serve_breaker_threshold=2,
+                           serve_breaker_cooldown_s=5.0)
+    t = [100.0]
+    faulty = FaultyInterface(_StubInterface(params), fail_at={0, 1})
+    handlers = rest_api._handlers(faulty)
+    guard = serving_guard.ServingGuard(params, clock=lambda: t[0])
+    responses = {}
+
+    def send(rid):
+        rest_api._process_group(
+            handlers, faulty, guard, responses,
+            [(rid, "/token_completion", {"tokens": [1]}, t[0] + 60)],
+            clock=lambda: t[0])
+        return responses[rid]["r"]
+
+    assert send("a")["_status"] == 500
+    assert send("b")["_status"] == 500
+    assert guard.breaker.state == "open"
+    out = send("c")
+    assert out["_status"] == 503 and out["_retry_after"] == 5.0
+    assert faulty.calls == 2            # the shed request never hit decode
+    t[0] += 5.0
+    group = [("probe", "/token_completion", {"tokens": [7]}, t[0] + 60),
+             ("extra", "/token_completion", {"tokens": [8]}, t[0] + 60)]
+    rest_api._process_group(handlers, faulty, guard, responses, group,
+                            clock=lambda: t[0])
+    assert responses["extra"]["r"]["_status"] == 503    # only ONE probe
+    assert responses["probe"]["r"]["tokens"][0] == 7
+    assert guard.breaker.state == "closed"
+    assert send("d")["tokens"][0] == 1
+
+
+def guarded_happy_path_smoke_test():
+    """No faults: the guarded device-loop path returns byte-identical JSON
+    to a direct handler call, and /completion matches the pre-guard
+    ``InterfaceWrapper.complete`` output."""
+    from rest_api_test import _interface
+    interface = _interface()
+    handlers = rest_api._handlers(interface)
+    body = {"tokens": [1, 2, 3], "temperature": 0.0}
+    direct = handlers["/token_completion"](dict(body))
+    guard = serving_guard.ServingGuard(interface.params)
+    responses = {}
+    now = time.monotonic()
+    rest_api._process_group(handlers, interface, guard, responses,
+                            [("rid", "/token_completion", dict(body),
+                              now + 600)])
+    assert (json.dumps(responses["rid"]["r"], sort_keys=True)
+            == json.dumps(direct, sort_keys=True))
+    direct = handlers["/completion"]({"prompt": "ab", "temperature": 0.0})
+    assert direct["completion"] == interface.complete("ab", 0.0)
+    assert "truncated" not in direct
+
+
+# -------------------------------------------------------- integration sweep
+
+def _spawn_serve(interface, control=None):
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stop = threading.Event()
+    t = threading.Thread(target=rest_api.serve,
+                         args=(interface.params, interface),
+                         kwargs={"port": port, "isolate": True, "stop": stop,
+                                 "control": control},
+                         daemon=True)
+    t.start()
+    return port, stop, t
+
+
+def _post(port, path, payload, timeout=30, connect_retries=120):
+    """POST returning (status, json_body, headers); retries only CONNECTION
+    failures (server not up yet / child mid-restart) — an HTTP error status
+    is a final answer and returns immediately."""
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    for _ in range(connect_retries):
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read()), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+        except (ConnectionError, urllib.error.URLError, OSError):
+            time.sleep(0.1)
+    raise TimeoutError(path)
+
+
+def health_liveness_under_wedged_decode_test():
+    """/health and /ready answer from the HTTP child without crossing the
+    device loop: they stay responsive while the loop is wedged inside a
+    decode; queued traffic hits the admission budget (429) and per-request
+    deadlines (504); every accepted request gets exactly one answer."""
+    # limit 3 = the wedged in-decode request (in-flight counts toward the
+    # budget) + the two queued behind it
+    params = _serve_params(serve_queue_limit=3, serve_request_deadline_s=8.0,
+                           serve_breaker_threshold=0, serve_batch_size=1,
+                           serve_max_response_tokens=16)
+    release = threading.Event()
+    faulty = FaultyInterface(_StubInterface(params), block_on=release,
+                             block_timeout_s=30.0)
+    port, stop, t = _spawn_serve(faulty)
+    try:
+        status, out, _ = _post(port, "/health", {})
+        assert status == 200 and out["status"] == "ok"
+        assert out["decode_path"] == {"loop": "stub"}
+        status, out, _ = _post(port, "/ready", {})
+        assert status == 200 and out["ready"] is True
+        # k8s-style GET probes work too
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/health",
+                                    timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready",
+                                    timeout=10) as r:
+            assert json.loads(r.read())["ready"] is True
+        # HTTP-edge rejections cost no device call while the loop is healthy
+        status, out, _ = _post(port, "/token_completion",
+                               {"tokens": [1], "max_tokens": 1000})
+        assert status == 400 and out["code"] == "bad_request"
+        status, out, _ = _post(port, "/token_completion",
+                               {"tokens": [1], "pad": "x" * (2 << 20)})
+        assert status == 400 and out["code"] == "bad_request"  # body cap
+        status, out, _ = _post(port, "/token_completion",
+                               {"tokens": [1], "repetition_penalty": 0})
+        assert status == 400 and out["code"] == "bad_request"  # device-side
+        assert faulty.calls == 0
+
+        results = {}
+
+        def bg(name, payload):
+            results[name] = _post(port, "/token_completion", payload,
+                                  timeout=25)
+
+        th1 = threading.Thread(target=bg, args=("wedged", {"tokens": [1]}),
+                               daemon=True)
+        th1.start()
+        deadline = time.monotonic() + 10
+        while faulty.calls < 1:      # the decode call is now in flight
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        status, out, _ = _post(port, "/health", {})
+        assert status == 200 and time.monotonic() - t0 < 2.0
+        # fill the pending budget behind the wedged decode
+        th2 = threading.Thread(target=bg, args=("queued", {"tokens": [2]}),
+                               daemon=True)
+        th2.start()
+        th3 = threading.Thread(target=bg,
+                               args=("expiring", {"tokens": [3],
+                                                  "timeout_s": 1.0}),
+                               daemon=True)
+        th3.start()
+        deadline = time.monotonic() + 10
+        while _post(port, "/health", {})[1]["queue_depth"] < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        status, out, headers = _post(port, "/token_completion",
+                                     {"tokens": [9]})
+        assert status == 429 and out["code"] == "overloaded"
+        assert "Retry-After" in headers
+        status, out, _ = _post(port, "/ready", {})
+        assert status == 503 and out["ready"] is False
+        th3.join(timeout=15)         # its 1 s deadline expires while queued
+        assert results["expiring"][0] == 504
+        assert results["expiring"][1]["code"] == "timeout"
+        time.sleep(0.2)              # ensure the expiry predates the release
+        release.set()
+        th1.join(timeout=15)
+        th2.join(timeout=15)
+        assert results["wedged"][0] == 200
+        assert results["queued"][0] == 200
+        assert results["wedged"][1]["tokens"][0] == 1
+    finally:
+        release.set()
+        stop.set()
+        t.join(timeout=15)
+    assert not t.is_alive()
+
+
+def malformed_transport_rejected_test():
+    """The fallback HTTP server answers chunked bodies and malformed
+    Content-Length with a structured 400 instead of silently treating the
+    body as empty (chunked) or crashing the handler (bad length)."""
+    import socket
+    stub = _StubInterface(_serve_params())
+    port, stop, t = _spawn_serve(stub)
+
+    def raw(request_bytes):
+        c = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c.sendall(request_bytes)
+        chunks = []
+        try:
+            while True:
+                d = c.recv(4096)
+                if not d:
+                    break
+                chunks.append(d)
+        except socket.timeout:
+            pass
+        c.close()
+        return b"".join(chunks)
+
+    try:
+        _post(port, "/health", {})      # wait for the server to come up
+        out = raw(b"POST /completion HTTP/1.1\r\nHost: x\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"5\r\nhello\r\n0\r\n\r\n")
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:80]
+        assert b"bad_request" in out
+        out = raw(b"POST /completion HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: abc\r\n\r\n")
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:80]
+        assert b"bad_request" in out
+        # negative length would read(-N) to EOF: a held-open connection
+        # would pin the handler thread and bypass the body-size cap
+        out = raw(b"POST /completion HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: -1\r\n\r\nxxxx")
+        assert b" 400 " in out.split(b"\r\n", 1)[0], out[:80]
+    finally:
+        stop.set()
+        t.join(timeout=15)
+
+
+def breaker_cycle_integration_test():
+    """End to end over real IPC: consecutive decode failures open the
+    breaker, 503s fast-fail well under the request deadline with a
+    Retry-After, a single successful probe recloses it, and traffic
+    resumes."""
+    params = _serve_params(serve_breaker_threshold=2,
+                           serve_breaker_cooldown_s=1.0, serve_batch_size=1)
+    faulty = FaultyInterface(_StubInterface(params), fail_at={0, 1})
+    port, stop, t = _spawn_serve(faulty)
+    try:
+        status, out, _ = _post(port, "/token_completion", {"tokens": [1]})
+        assert status == 500 and out["code"] == "server_error"
+        status, out, _ = _post(port, "/token_completion", {"tokens": [2]})
+        assert status == 500
+        t0 = time.monotonic()
+        status, out, headers = _post(port, "/token_completion",
+                                     {"tokens": [3]})
+        elapsed = time.monotonic() - t0
+        assert status == 503 and out["code"] == "unavailable"
+        assert elapsed < 0.5, elapsed    # fast-fail target is < 100 ms
+        assert "Retry-After" in headers
+        _, health, _ = _post(port, "/health", {})
+        assert health["breaker"] in ("open", "half_open")
+        assert health["decode_failures"] == 2
+        assert health["breaker_trips"] == 1
+        status, ready, _ = _post(port, "/ready", {})
+        assert status == 503 and ready["ready"] is False
+        assert faulty.calls == 2         # shed requests never reached decode
+        time.sleep(1.2)                  # cooldown elapses
+        deadline = time.monotonic() + 10
+        while True:                      # probe; tolerate a stale open state
+            status, out, _ = _post(port, "/token_completion", {"tokens": [7]})
+            if status == 200:
+                break
+            assert status == 503
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        assert out["tokens"][0] == 7
+        deadline = time.monotonic() + 5
+        while _post(port, "/health", {})[1]["breaker"] != "closed":
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        status, out, _ = _post(port, "/token_completion", {"tokens": [9]})
+        assert status == 200             # reclosed: traffic flows again
+    finally:
+        stop.set()
+        t.join(timeout=15)
+
+
+def http_child_kill_relaunch_test():
+    """A SIGKILLed HTTP subprocess is relaunched with bounded backoff: the
+    device loop survives, the child pid changes, /health counts the
+    restart, and completions flow end to end afterwards."""
+    params = _serve_params(serve_child_max_restarts=3,
+                           serve_child_restart_backoff_s=0.1,
+                           serve_batch_size=1)
+    stub = _StubInterface(params)
+    control = {}
+    port, stop, t = _spawn_serve(stub, control=control)
+    try:
+        status, out, _ = _post(port, "/encode", {"prompt": "hi"})
+        assert status == 200 and out["tokens"] == [104, 105]
+        pid1 = control["child_pid"]
+        os.kill(pid1, signal.SIGKILL)
+        status, out, _ = _post(port, "/encode", {"prompt": "hi"},
+                               connect_retries=300)
+        assert status == 200 and out["tokens"] == [104, 105]
+        assert control["child_pid"] != pid1
+        _, health, _ = _post(port, "/health", {})
+        assert health["child_restarts"] == 1
+        status, out, _ = _post(port, "/token_completion", {"tokens": [1, 2]})
+        assert status == 200 and out["tokens"][:2] == [1, 2]
+        assert t.is_alive()              # the device loop never died
+    finally:
+        stop.set()
+        t.join(timeout=15)
+    assert not t.is_alive()
