@@ -49,6 +49,9 @@ BENCH_CONFIG = {
 
 WARMUP_STEPS = 2
 MEASURE_STEPS = 10
+#: instrumented steps for the phase-attribution companion (run AFTER the
+#: headline measurement so its per-step device sync can't touch the number)
+PHASE_STEPS = 5
 
 
 def _ensure_live_backend():
@@ -141,6 +144,43 @@ def main() -> int:
     final_loss = float(metrics["loss"])  # value fetch = true device sync
     dt = time.time() - t0
 
+    # step-phase attribution (docs/OBSERVABILITY.md): a short instrumented
+    # pass so BENCH_* files carry data-wait / dispatch / device-block
+    # medians and prefetcher stall totals, not just the end-to-end number.
+    # Runs on a PRIVATE registry after the headline loop — the per-step
+    # sync it needs cannot contaminate the headline measurement.
+    telemetry_summary = None
+    try:
+        from homebrewnlp_tpu import telemetry
+        from homebrewnlp_tpu.data.inputs import Prefetcher
+        reg = telemetry.Registry()
+        prev_reg = telemetry.set_registry(reg)
+        try:
+            phases = telemetry.StepPhases(registry=reg)
+            mono = time.monotonic
+            feed = Prefetcher((make_batch() for _ in range(PHASE_STEPS)),
+                              depth=2, telemetry_label="bench")
+            try:
+                for _ in range(PHASE_STEPS):
+                    tp0 = mono()
+                    b = next(feed)
+                    tp1 = mono()
+                    phases.data_wait.rec(tp0, tp1 - tp0)
+                    state, pm = trainer.step(state, b)
+                    tp2 = mono()
+                    phases.dispatch.rec(tp1, tp2 - tp1)
+                    float(pm["loss"])  # device sync attributes device time
+                    phases.device_block.rec(tp2, mono() - tp2)
+            finally:
+                # a mid-pass failure must not leak the fill thread and its
+                # pinned batches into the decode companion's memory budget
+                feed.close()
+            telemetry_summary = telemetry.summarize(reg.snapshot())
+        finally:
+            telemetry.set_registry(prev_reg)
+    except Exception as exc:
+        print(f"telemetry phase attribution failed: {exc}", file=sys.stderr)
+
     tokens = MEASURE_STEPS * params.train_batch_size * params.sequence_length
     n_chips = max(1, len(jax.devices()))
     tokens_per_sec_chip = tokens / dt / n_chips
@@ -213,6 +253,8 @@ def main() -> int:
         out["mfu_causal"] = round(mfu_causal, 4)
     if val_loss is not None:
         out["val_loss"] = round(val_loss, 4)
+    if telemetry_summary is not None:
+        out["telemetry"] = telemetry_summary
     # the headline line goes out NOW: the companion's 16k compile can kill
     # the PROCESS (worker crash / OOM), which no except clause survives — a
     # consumer taking the last JSON line sees the enriched line when the

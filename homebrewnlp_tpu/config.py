@@ -320,6 +320,30 @@ class ModelParameter:
         # permanently wedged loop.  0 = off (a long decode also ages the
         # heartbeat — pick a threshold above the worst-case decode)
         self.serve_heartbeat_stale_s = 0.0
+        # ---- telemetry (docs/OBSERVABILITY.md) ----
+        # master switch for TRAIN-LOOP instrumentation: step-phase histograms
+        # (data-wait / dispatch / device-block), prefetcher gauges, JSONL /
+        # chrome-trace dumps.  Costs one device sync per step to attribute
+        # device time (same trap/cost note as nonfinite_loss_tolerance);
+        # measured <2% of step time.  Off = exactly ZERO registry calls on
+        # the step hot path.  Rare-event layers (storage retries, checkpoint
+        # IO, serving decode rounds) record regardless — their cadence is
+        # storage/request-bound, and GET /metrics is always served
+        self.telemetry_enabled = False
+        # with telemetry on: append a registry-snapshot JSONL line to
+        # <model_path>/telemetry.jsonl at most every N seconds (checked at
+        # the metric-log cadence).  0 = no JSONL dump
+        self.telemetry_jsonl_interval_s = 0.0
+        # with telemetry on: keep the last N span events and write them as
+        # Chrome-trace JSON (<model_path>/telemetry_trace.json, loadable in
+        # Perfetto / chrome://tracing) at run end.  0 = no trace recording
+        self.telemetry_chrome_trace_events = 0
+        # opt-in: SIGUSR2 captures a jax.profiler trace of the next
+        # telemetry_profile_steps steps into <model_path>/profile/
+        # on_demand_<step> (a second SIGUSR2 stops early).  Independent of
+        # telemetry_enabled — profiling has no per-step cost until triggered
+        self.telemetry_profile_on_signal = False
+        self.telemetry_profile_steps = 10
 
         self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():
@@ -352,6 +376,14 @@ class ModelParameter:
             v = getattr(self, knob)
             if v < 0:
                 raise ValueError(f"{knob} must be >= 0, got {v}")
+        for knob in ("telemetry_jsonl_interval_s",
+                     "telemetry_chrome_trace_events"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0 (0 = off), got "
+                                 f"{getattr(self, knob)}")
+        if self.telemetry_profile_steps < 1:
+            raise ValueError("telemetry_profile_steps must be >= 1, got "
+                             f"{self.telemetry_profile_steps}")
         if self.serve_request_deadline_s <= 0:
             raise ValueError("serve_request_deadline_s must be > 0 (it is "
                              "the default deadline, not just a cap), got "

@@ -432,25 +432,58 @@ class Prefetcher:
     ``close()`` releases an abandoned prefetcher: without it the fill
     thread stays blocked on its full queue forever, pinning the source
     iterator's open file buffers (measured skewing co-resident
-    measurements badly — scripts/bench_loader.py)."""
+    measurements badly — scripts/bench_loader.py).
 
-    def __init__(self, iterable, depth: int = 2):
+    ``telemetry_label``: when set (the train loop passes it under
+    ``telemetry_enabled``), the prefetcher records a queue-depth gauge,
+    fill-stall and bounded-put retry counters, and item totals into the
+    process registry under ``queue=<label>`` (docs/OBSERVABILITY.md).
+    None (the default) makes zero registry calls."""
+
+    def __init__(self, iterable, depth: int = 2,
+                 telemetry_label: typing.Optional[str] = None):
         self.q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._done = object()
         self._stop = False
         self._error: typing.Optional[BaseException] = None
+        self._tel = None
+        if telemetry_label is not None:
+            from ..telemetry import registry as _reg
+            r = _reg()
+            lab = dict(queue=telemetry_label)
+            self._tel = (
+                r.gauge("hbnlp_prefetch_queue_depth",
+                        "items buffered ahead of the consumer",
+                        ("queue",)).labels(**lab),
+                r.counter("hbnlp_prefetch_fill_stalls_total",
+                          "fill-thread put timeouts on a full queue (the "
+                          "device outran the loader: good) ",
+                          ("queue",)).labels(**lab),
+                r.counter("hbnlp_prefetch_items_total",
+                          "items handed to the consumer",
+                          ("queue",)).labels(**lab),
+                r.counter("hbnlp_prefetch_consumer_waits_total",
+                          "consumer get() calls that found the queue empty "
+                          "(the loader is the bottleneck: bad)",
+                          ("queue",)).labels(**lab),
+            )
         self.thread = threading.Thread(target=self._fill, args=(iterable,),
                                        daemon=True)
         self.thread.start()
 
     def _fill(self, iterable):
+        tel = self._tel
         try:
             for item in iterable:
                 while not self._stop:
                     try:
                         self.q.put(item, timeout=0.2)
+                        if tel is not None:
+                            tel[0].set(self.q.qsize())
                         break
                     except queue.Full:
+                        if tel is not None:
+                            tel[1].inc()
                         continue
                 if self._stop:
                     return
@@ -484,12 +517,18 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        tel = self._tel
+        if tel is not None and self.q.qsize() == 0:
+            tel[3].inc()
         item = self.q.get()
         if item is self._done:
             if self._error is not None:
                 error, self._error = self._error, None
                 raise error
             raise StopIteration
+        if tel is not None:
+            tel[2].inc()
+            tel[0].set(self.q.qsize())
         return item
 
 

@@ -1,8 +1,9 @@
 """REST serving mode (reference: /root/reference/src/rest_api.py).
 
 Endpoints: /completion, /token_completion, /encode, /decode, /health,
-/ready, mirroring the reference's RestAPI surface (:74-89) plus the
-reliability surface from docs/RELIABILITY.md 'Serving'.  fastapi/uvicorn
+/ready, /metrics, mirroring the reference's RestAPI surface (:74-89) plus
+the reliability surface from docs/RELIABILITY.md 'Serving' and the
+Prometheus scrape target from docs/OBSERVABILITY.md.  fastapi/uvicorn
 are optional — when absent (as in this image) a dependency-free fallback
 HTTP server provides the same JSON endpoints so web_api mode always works.
 
@@ -29,17 +30,24 @@ import time
 import typing
 import uuid
 
+from .. import telemetry
 from ..config import ModelParameter
 from .interface import InterfaceWrapper
 from .serving_guard import (HTTPStatusError, ServingGuard, child_health,
                             child_ready, poll_delay, request_deadline_s,
-                            serve_config, validate_request)
+                            serve_config, state_metrics, validate_request)
 
 DEFAULT_PORT = 62220
 
 BATCHED_PATHS = ("/completion", "/token_completion")
 # endpoints load balancers / k8s probe with GET (POST works on them too)
 PROBE_PATHS = ("/health", "/ready")
+# GET-able endpoints: the probes plus the Prometheus scrape target; like the
+# probes, /metrics is answered from shared state + the local registry —
+# never by crossing the device loop (docs/OBSERVABILITY.md)
+GET_PATHS = PROBE_PATHS + ("/metrics",)
+#: Prometheus text exposition content type (format version 0.0.4)
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # error payloads ride the responses dict as {"_error": ..., "_status": ...,
 # "_code": ...[, "_retry_after": ...]}; the HTTP child renders them with the
@@ -59,6 +67,59 @@ _CLIENT_ERRORS = (ValueError, TypeError, OverflowError)
 
 def _err(exc_or_msg, kind: dict) -> dict:
     return {"_error": str(exc_or_msg), **kind}
+
+
+# ---- serving telemetry (docs/OBSERVABILITY.md) ------------------------------
+# Recorded unconditionally: a decode round costs milliseconds-to-seconds,
+# the observations nanoseconds — and the registry is what GET /metrics
+# serves.  Created lazily ONCE per process (device loop and HTTP child each
+# have their own registry; the child merges the device side's IPC-published
+# snapshot at scrape time).
+_SERVE_METRICS = None
+
+
+def _serve_metrics() -> dict:
+    global _SERVE_METRICS
+    if _SERVE_METRICS is None:
+        r = telemetry.registry()
+        _SERVE_METRICS = {
+            "queue_wait": r.histogram(
+                "hbnlp_serve_queue_wait_seconds",
+                "seconds between HTTP-child enqueue and device-loop pickup"),
+            "decode": r.histogram(
+                "hbnlp_serve_decode_seconds",
+                "wall seconds per decode call (batched calls count once)"),
+            "tps": r.histogram(
+                "hbnlp_serve_tokens_per_second",
+                "generated tokens per second per decode call",
+                buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
+                         5000, 10000)),
+            "batch": r.histogram(
+                "hbnlp_serve_batch_size",
+                "completion requests sharing one decode round",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128)),
+        }
+    return _SERVE_METRICS
+
+
+def _record_decode(dt: float, generated_tokens: int):
+    m = _serve_metrics()
+    m["decode"].observe(dt)
+    if dt > 0:
+        m["tps"].observe(generated_tokens / dt)
+
+
+def _metrics_exposition(state=None, queue_depth: int = 0) -> dict:
+    """The ``/metrics`` payload: local registry + (child-side) the device
+    loop's snapshot from shared IPC state and the guard counters reshaped
+    as series.  The ``_prometheus`` key makes both server branches render
+    text/plain instead of JSON."""
+    parts = []
+    if state is not None:
+        parts.append(state.get("metrics") or {})
+        parts.append(state_metrics(state, queue_depth))
+    parts.append(telemetry.snapshot())
+    return {"_prometheus": telemetry.prometheus_text(*parts)}
 
 
 def _prompt_capacity(interface) -> int:
@@ -128,10 +189,13 @@ def _complete_one(interface, path: str, parsed) -> dict:
     branch (parsing already happened; any exception here is a decode
     failure)."""
     toks, temp, rl, tk, tp, rp = parsed
+    t0 = time.monotonic()
     out = interface.complete_tokens(toks, temp, rl, top_k=tk, top_p=tp,
                                     repetition_penalty=rp)
-    return _format_completion(interface, path, toks, out,
-                              _prompt_capacity(interface))
+    kept_limit = _prompt_capacity(interface)
+    _record_decode(time.monotonic() - t0,
+                   max(0, len(out) - min(len(toks), kept_limit)))
+    return _format_completion(interface, path, toks, out, kept_limit)
 
 
 def _complete_batch(interface: InterfaceWrapper,
@@ -174,9 +238,13 @@ def _complete_batch(interface: InterfaceWrapper,
 
     if idx:
         try:
+            t0 = clock()
             outs = interface.complete_tokens_batch(prompts, temps, rls,
                                                    top_ks=tks, top_ps=tps,
                                                    rep_penalties=rps)
+            _record_decode(clock() - t0,
+                           sum(max(0, len(o) - min(len(p), kept_limit))
+                               for p, o in zip(prompts, outs)))
             for j, i in enumerate(idx):
                 results[i] = _format(i, j, outs[j])
             if guard is not None:
@@ -193,9 +261,15 @@ def _complete_batch(interface: InterfaceWrapper,
                                       "retry", _TIMEOUT)
                     continue
                 try:
+                    t1 = clock()
                     out = interface.complete_tokens(
                         prompts[j], temps[j], rls[j], top_k=tks[j],
                         top_p=tps[j], repetition_penalty=rps[j])
+                    # retry decodes record too — otherwise the latency
+                    # histograms go blind exactly during an incident
+                    _record_decode(clock() - t1,
+                                   max(0, len(out) - min(len(prompts[j]),
+                                                         kept_limit)))
                     results[i] = _format(i, j, out)
                     if guard is not None:
                         guard.record_decode_success()
@@ -266,9 +340,16 @@ def _handlers(interface: InterfaceWrapper):
         is no queue or breaker in front of it."""
         return {"ready": True, "breaker": "closed", "queue_depth": 0}
 
+    def metrics(body: dict) -> dict:
+        """In-process scrape target: the local registry is the only metrics
+        source (no IPC state exists).  In the isolated path this handler is
+        never reached — the HTTP child intercepts /metrics and merges the
+        device loop's published snapshot itself."""
+        return _metrics_exposition()
+
     return {"/completion": completion, "/token_completion": token_completion,
             "/encode": encode, "/decode": decode, "/health": health,
-            "/ready": ready}
+            "/ready": ready, "/metrics": metrics}
 
 
 def _retry_after_header(retry_after: typing.Optional[float]
@@ -323,13 +404,21 @@ def _run_http(port: int, paths: typing.List[str],
                                   f"serve_max_body_bytes={max_body_bytes}",
                          "code": "bad_request"}, status_code=400)
                 return await call_next(request)
+        from fastapi.responses import PlainTextResponse
+
         def _run_dispatch(p, body):
             # JSONResponse, not HTTPException: the payload must stay at the
             # TOP level ({"error", "code"}), the one contract both server
             # branches share — HTTPException would wrap it under
             # {"detail": ...}
             try:
-                return dispatch(p, body)
+                out = dispatch(p, body)
+                if isinstance(out, dict) and "_prometheus" in out:
+                    # /metrics: Prometheus scrapers need text exposition,
+                    # not a JSON-encoded string of it
+                    return PlainTextResponse(out["_prometheus"],
+                                             media_type=METRICS_CONTENT_TYPE)
+                return out
             except HTTPStatusError as e:
                 ra = _retry_after_header(e.retry_after)
                 return JSONResponse(
@@ -364,17 +453,17 @@ def _run_http(port: int, paths: typing.List[str],
                         return JSONResponse(
                             {"error": "JSON object body required",
                              "code": "bad_request"}, status_code=400)
-                    if p in PROBE_PATHS:
-                        # probes are sub-ms shared-state reads: answered
-                        # inline, NOT via the threadpool, whose bounded
-                        # tokens slow completion polls can exhaust — the
-                        # probes must stay responsive exactly then
+                    if p in GET_PATHS:
+                        # probes and /metrics are sub-ms shared-state reads:
+                        # answered inline, NOT via the threadpool, whose
+                        # bounded tokens slow completion polls can exhaust —
+                        # they must stay responsive exactly then
                         return _run_dispatch(p, body)
                     return await run_in_threadpool(_run_dispatch, p, body)
                 return endpoint
             app.post(path)(make_endpoint())
-            if path in PROBE_PATHS:
-                # load balancers and k8s probe with GET
+            if path in GET_PATHS:
+                # load balancers / k8s probe with GET; Prometheus scrapes GET
                 def make_get(p=path):
                     async def get_endpoint():
                         return _run_dispatch(p, {})
@@ -390,12 +479,18 @@ def _run_http(port: int, paths: typing.List[str],
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, status: int, payload: dict,
                    retry_after: typing.Optional[float] = None):
-            data = json.dumps(payload).encode()
+            if isinstance(payload, dict) and "_prometheus" in payload:
+                # /metrics: scrapers need the text exposition itself
+                data = payload["_prometheus"].encode()
+                ctype = METRICS_CONTENT_TYPE
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
             ra = _retry_after_header(retry_after)
             if ra is not None:
                 self.send_header("Retry-After", ra)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -448,8 +543,9 @@ def _run_http(port: int, paths: typing.List[str],
             self._dispatch_reply(body)
 
         def do_GET(self):
-            # load balancers and k8s probe /health and /ready with GET
-            if self.path not in PROBE_PATHS or self.path not in paths:
+            # load balancers / k8s probe /health + /ready with GET;
+            # Prometheus scrapes /metrics with GET
+            if self.path not in GET_PATHS or self.path not in paths:
                 self.send_response(404)
                 self.end_headers()
                 return
@@ -485,6 +581,18 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
     import threading
     cfg = cfg or {}
     mono = time.monotonic
+    # child-side admission telemetry (the serving_guard admission decisions
+    # happen HERE, so their counters live in this process's registry; the
+    # scrape handler below merges the device loop's snapshot in)
+    _admission = telemetry.registry().counter(
+        "hbnlp_serve_admission_total",
+        "HTTP-child admission decisions", ("decision",))
+    _adm = {k: _admission.labels(decision=k)
+            for k in ("accepted", "rejected_invalid", "rejected_overloaded",
+                      "breaker_fast_fail", "deadline_timeout")}
+    _requests_ctr = telemetry.registry().counter(
+        "hbnlp_http_requests_total", "requests dispatched by the HTTP child",
+        ("path",))
     # fallback depth for platforms whose Queue.qsize raises (macOS):
     # dispatches outstanding FROM THIS CHILD (queued + in decode) — close
     # enough for the admission budget and the /ready watermark, and far
@@ -505,6 +613,12 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
         return depth
 
     def dispatch(path: str, body: dict) -> dict:
+        _requests_ctr.labels(path=path).inc()
+        if path == "/metrics":
+            # scrape target: local (admission) registry + the device loop's
+            # snapshot published over the heartbeat IPC + the guard counters
+            # from shared state — never crossing the device loop
+            return _metrics_exposition(state, queue_depth())
         if state is not None and path == "/health":
             payload = child_health(state, queue_depth(), cfg)
             if payload["status"] != "ok":
@@ -517,15 +631,21 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
             if not ok:
                 raise HTTPStatusError(503, payload, retry_after=1.0)
             return payload
-        validate_request(path, body, cfg)
+        try:
+            validate_request(path, body, cfg)
+        except HTTPStatusError:
+            _adm["rejected_invalid"].inc()
+            raise
         if (state is not None and path in BATCHED_PATHS
                 and state.get("breaker") == "open"):
             ra = max(0.0, state.get("breaker_open_until", 0.0) - mono())
+            _adm["breaker_fast_fail"].inc()
             raise HTTPStatusError(
                 503, {"error": "circuit breaker open: decode is failing",
                       "code": "unavailable"}, retry_after=ra)
         limit = int(cfg.get("queue_limit", 0) or 0)
         if limit and queue_depth() >= limit:
+            _adm["rejected_overloaded"].inc()
             raise HTTPStatusError(
                 429, {"error": f"server at capacity ({limit} pending "
                                "requests)", "code": "overloaded"},
@@ -533,10 +653,14 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
         deadline_s = request_deadline_s(body, cfg)
         deadline = mono() + deadline_s
         rid = uuid.uuid4().hex
+        _adm["accepted"].inc()
         with outstanding_lock:
             outstanding[0] += 1
         try:
-            requests.put((rid, path, body, deadline))
+            # the 5th field is the enqueue timestamp: the device loop's
+            # queue-wait histogram reads it (CLOCK_MONOTONIC is system-wide,
+            # same cross-process argument as the deadline)
+            requests.put((rid, path, body, deadline, mono()))
             delay = 0.0
             while True:
                 # pop-with-default: ONE Manager round-trip per poll (a
@@ -547,6 +671,7 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
                 if mono() >= deadline:
                     # the device loop writes its own 504 when it sheds the
                     # request; an uncollected answer is pruned by the loop
+                    _adm["deadline_timeout"].inc()
                     raise HTTPStatusError(
                         504, {"error": f"request exceeded its {deadline_s:g}s"
                                        " deadline", "code": "timeout"})
@@ -583,8 +708,11 @@ def _process_group(handlers, interface: InterfaceWrapper,
         responses[rid] = {"t": now, "r": payload}
 
     live = []
+    qw = _serve_metrics()["queue_wait"]
     for g in group:
         deadline = g[3] if len(g) > 3 else None
+        if len(g) > 4 and g[4] is not None:
+            qw.observe(max(0.0, now - g[4]))
         if deadline is not None and now >= deadline:
             # answered, not silently dropped: the client learns immediately
             # instead of burning the rest of its timeout
@@ -621,6 +749,7 @@ def _process_group(handlers, interface: InterfaceWrapper,
             respond(g[0], {**_err("circuit breaker half-open: probing",
                                   _UNAVAILABLE), "_retry_after": 1.0})
         batchable = batchable[:1]
+    _serve_metrics()["batch"].observe(len(batchable))
     if len(batchable) == 1:
         rid, path, body = batchable[0][0], batchable[0][1], batchable[0][2]
         try:

@@ -157,6 +157,42 @@ def poll_delay(delay: float, start: float = 0.002, ceiling: float = 0.05,
     return min(max(delay, start) * growth, ceiling)
 
 
+#: numeric encoding of the breaker state for the Prometheus gauge
+BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
+BREAKER_GAUGE = "hbnlp_serve_breaker_state"
+
+
+def state_metrics(state, queue_depth: int) -> dict:
+    """The serving_guard counters that live in shared IPC state, re-shaped
+    as a telemetry snapshot so ``GET /metrics`` exports them as first-class
+    series (docs/OBSERVABILITY.md).  Built child-side from the state dict —
+    never crossing the device loop."""
+
+    def scalar(kind: str, help_: str, value) -> dict:
+        return {"kind": kind, "help": help_, "labels": (), "buckets": [],
+                "series": {(): float(value or 0)}}
+
+    return {
+        "hbnlp_serve_queue_depth": scalar(
+            "gauge", "queued + in-decode completion requests", queue_depth),
+        BREAKER_GAUGE: scalar(
+            "gauge", "circuit breaker state: 0=closed 1=half_open 2=open",
+            BREAKER_STATES.get(state.get("breaker", "closed"), 0)),
+        "hbnlp_serve_decode_calls_total": scalar(
+            "counter", "decode calls issued by the device loop",
+            state.get("decode_calls", 0)),
+        "hbnlp_serve_decode_failures_total": scalar(
+            "counter", "decode calls that raised (breaker input)",
+            state.get("decode_failures", 0)),
+        "hbnlp_serve_breaker_trips_total": scalar(
+            "counter", "times the circuit breaker opened",
+            state.get("breaker_trips", 0)),
+        "hbnlp_serve_child_restarts_total": scalar(
+            "counter", "HTTP child subprocess relaunches",
+            state.get("child_restarts", 0)),
+    }
+
+
 class CircuitBreaker:
     """closed -> open after ``threshold`` CONSECUTIVE decode failures; while
     open, requests fast-fail (503) for ``cooldown_s``; then ``tick()`` moves
@@ -231,14 +267,27 @@ class ServingGuard:
 
     def publish(self, state, interface=None, restarts: int = 0):
         # one .update call = one IPC round-trip (per-key assignment would be
-        # one each); runs once per device-loop poll
+        # one each); runs once per device-loop poll.  The registry snapshot
+        # rides the same update: it is how GET /metrics in the HTTP child
+        # sees the device loop's decode/queue-wait histograms WITHOUT ever
+        # crossing the device loop (same invariant as /health)
+        breaker_state = self.breaker.tick()
+        try:
+            from ..telemetry import registry as _reg, snapshot as _snapshot
+            _reg().gauge(BREAKER_GAUGE,
+                         "circuit breaker state: 0=closed 1=half_open 2=open"
+                         ).set(BREAKER_STATES.get(breaker_state, 0))
+            snap = _snapshot()
+        except Exception:
+            snap = {}
         state.update(hb=self.clock(),
-                     breaker=self.breaker.tick(),
+                     breaker=breaker_state,
                      breaker_open_until=self.breaker.open_until,
                      breaker_trips=self.breaker.opened,
                      decode_failures=self.decode_failures,
                      decode_calls=int(getattr(interface, "decode_calls", 0) or 0),
-                     child_restarts=int(restarts))
+                     child_restarts=int(restarts),
+                     metrics=snap)
 
 
 def child_health(state, queue_depth: int, cfg: typing.Dict[str, typing.Any],

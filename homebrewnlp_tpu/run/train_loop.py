@@ -171,7 +171,9 @@ def make_dataset(params: ModelParameter, repeat: bool = True, mesh=None):
                               runs_log=runs_log or None, repeat=repeat,
                               holdout=holdout)
     return Prefetcher(_macro_batches(dataset, params.macro_batching),
-                      depth=params.buffer_size)
+                      depth=params.buffer_size,
+                      telemetry_label="train" if params.telemetry_enabled
+                      else None)
 
 
 def make_eval_batches(params: ModelParameter, mesh=None
@@ -289,6 +291,39 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             eval_batches = make_eval_batches(params, mesh=mesh)
 
     logger = MetricLogger(params.model_path) if is_chief else None
+    # ---- telemetry (docs/OBSERVABILITY.md): everything below is created
+    # ONCE, outside the loop; when telemetry_enabled is false, `phases` is
+    # None and the step loop makes exactly zero registry calls
+    phases = None
+    tel_trace = None
+    tel_nonfinite = tel_preempt = None
+    tel_jsonl = None
+    tel_jsonl_last = [0.0]
+    if params.telemetry_enabled:
+        from .. import telemetry
+        if params.telemetry_chrome_trace_events:
+            tel_trace = telemetry.ChromeTrace(
+                params.telemetry_chrome_trace_events)
+        phases = telemetry.StepPhases(trace=tel_trace)
+        reg = telemetry.registry()
+        tel_nonfinite = reg.counter(
+            "hbnlp_train_nonfinite_skips_total",
+            "steps whose update was skipped on a non-finite loss")
+        tel_preempt = reg.counter(
+            "hbnlp_train_preemptions_total",
+            "graceful SIGTERM/SIGINT stops (emergency checkpoint written)")
+        if is_chief and params.telemetry_jsonl_interval_s > 0:
+            tel_jsonl = fs.open_(fs.join(params.model_path,
+                                         "telemetry.jsonl"), "a")
+    # on-demand XLA profiling is independent of telemetry_enabled: it has
+    # zero per-step cost until a SIGUSR2 actually requests a capture
+    profiler_od = None
+    if params.telemetry_profile_on_signal:
+        from ..telemetry import OnDemandProfiler
+        profiler_od = OnDemandProfiler(
+            os.path.join(params.model_path, "profile"),
+            params.telemetry_profile_steps)
+        profiler_od.install_signal()
     total_steps = train_steps if train_steps is not None else params.train_steps
     tokens_per_step = (params.train_batch_size * params.sequence_length
                        * params.macro_batching)
@@ -349,9 +384,21 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             broadcast_ok[0] = False
             return shutdown.requested
 
+    mono = time.monotonic
     try:
         batch = first_batch
         data_it = iter(data)
+
+        def next_batch():
+            """One data fetch, with the data-wait phase recorded when
+            telemetry is on (StopIteration propagates untimed)."""
+            if phases is None:
+                return next(data_it)
+            t0 = mono()
+            b = next(data_it)
+            phases.data_wait.rec(t0, mono() - t0)
+            return b
+
         profiling = False
         # host-side step mirror: never block on state.step (a device sync per
         # step would serialise dispatch against compute)
@@ -365,8 +412,23 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 elif profiling and step_now >= profile_steps[1]:
                     jax.profiler.stop_trace()
                     profiling = False
+            if profiler_od is not None:
+                profiler_od.poll(step_now)
             it_count += 1
-            state, metrics = trainer.step(state, batch)
+            if phases is None:
+                state, metrics = trainer.step(state, batch)
+            else:
+                t0 = mono()
+                state, metrics = trainer.step(state, batch)
+                t1 = mono()
+                phases.dispatch.rec(t0, t1 - t0)
+                # attributing device time requires waiting for the step to
+                # finish: one device sync per step, the same documented cost
+                # as nonfinite_loss_tolerance (CONFIG.md; measured <2% of
+                # step time — dispatch of the NEXT step is sub-ms and the
+                # prefetcher keeps data decode off this thread)
+                jax.block_until_ready(metrics["loss"])
+                phases.device_block.rec(t1, mono() - t1)
             consumed += params.macro_batching
             if params.nonfinite_loss_tolerance > 0:
                 # the jitted step already SKIPPED the update on-device for a
@@ -377,6 +439,8 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 loss_now = float(np.asarray(jax.device_get(metrics["loss"])))
                 if not np.isfinite(loss_now):
                     nonfinite_streak += 1
+                    if tel_nonfinite is not None:
+                        tel_nonfinite.inc()
                     print(f"WARNING: non-finite loss ({loss_now}) at step "
                           f"{step_now}; update skipped "
                           f"({nonfinite_streak}/"
@@ -394,7 +458,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                         stopped = True
                         break
                     try:
-                        batch = next(data_it)
+                        batch = next_batch()
                     except StopIteration:
                         break
                     continue
@@ -407,7 +471,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 print(f"debug_train_step: dispatched step {step_now}; "
                       f"fetching next batch", flush=True)
             try:
-                batch = next(data_it)
+                batch = next_batch()
             except StopIteration:
                 break
             if params.moe_metrics_interval and \
@@ -435,6 +499,12 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 if logger is not None:
                     logger.log(step_now, metrics,
                                tokens_per_step=params.train_batch_size * params.sequence_length)
+                if tel_jsonl is not None and \
+                        mono() - tel_jsonl_last[0] >= params.telemetry_jsonl_interval_s:
+                    tel_jsonl.write(telemetry.jsonl_line(
+                        telemetry.snapshot(), step=step_now) + "\n")
+                    tel_jsonl.flush()
+                    tel_jsonl_last[0] = mono()
             # every process participates in a distributed save (the save
             # itself barriers and assigns writer roles); single-process
             # saves are chief-trivially
@@ -454,21 +524,53 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # process mid-emergency-save, losing exactly the checkpoint this
         # path exists to write
         try:
-            if profile_steps is not None and profiling:
-                jax.profiler.stop_trace()
-            if params.use_checkpointing:
-                ckpt.save(params.model_path, int(state.step), state.variables,
-                          state.opt_state, params.max_checkpoints_keep)
-            # rewrite the run log entry with the steps actually consumed
-            log = read_runs_log(params) \
-                if is_chief and not params.use_random_dataloader else None
-            if log:
-                log[-1]["steps"] = consumed
-                with fs.open_(fs.join(params.model_path, "DataLog.log"), "w") as f:
-                    for entry in log:
-                        f.write(json.dumps(entry) + "\n")
-            if logger is not None:
-                logger.close()
+            try:
+                if profile_steps is not None and profiling:
+                    jax.profiler.stop_trace()
+                if profiler_od is not None:
+                    profiler_od.close()
+                if stopped and tel_preempt is not None:
+                    tel_preempt.inc()
+                if logger is not None:
+                    # flush the final metrics window BEFORE the emergency
+                    # save: the 30s REMOTE_FLUSH_S cadence lost it on every
+                    # preemption whenever the save hung or raised (and
+                    # close() below never ran when save raised at all)
+                    logger.flush()
+                if params.use_checkpointing:
+                    ckpt.save(params.model_path, int(state.step), state.variables,
+                              state.opt_state, params.max_checkpoints_keep)
+                # rewrite the run log entry with the steps actually consumed
+                log = read_runs_log(params) \
+                    if is_chief and not params.use_random_dataloader else None
+                if log:
+                    log[-1]["steps"] = consumed
+                    with fs.open_(fs.join(params.model_path, "DataLog.log"), "w") as f:
+                        for entry in log:
+                            f.write(json.dumps(entry) + "\n")
+            finally:
+                # runs even when the emergency save raises — the metrics
+                # files must never be the casualty of a storage failure
+                if logger is not None:
+                    logger.close()
+                if tel_jsonl is not None:
+                    try:
+                        tel_jsonl.write(telemetry.jsonl_line(
+                            telemetry.snapshot(), step=step_now) + "\n")
+                        tel_jsonl.close()
+                    except Exception as e:
+                        print(f"WARNING: final telemetry.jsonl write failed:"
+                              f" {e}", flush=True)
+                if tel_trace is not None and is_chief:
+                    try:
+                        path = fs.join(params.model_path,
+                                       "telemetry_trace.json")
+                        tel_trace.dump(path)
+                        print(f"telemetry: chrome trace written to {path}",
+                              flush=True)
+                    except Exception as e:
+                        print(f"WARNING: chrome trace dump failed: {e}",
+                              flush=True)
         finally:
             for sig, handler in prev_handlers.items():
                 signal.signal(sig, handler)

@@ -1,0 +1,32 @@
+"""Telemetry subsystem (docs/OBSERVABILITY.md).
+
+Three small stdlib-only pieces every layer shares:
+
+* ``registry`` — process-wide Counter/Gauge/Histogram table with labels,
+  picklable ``snapshot()`` for IPC, Prometheus text-exposition and JSONL
+  renderers (``GET /metrics`` is ``prometheus_text(snapshot())``).
+* ``spans`` — ``with span("name"): ...`` + pre-bound ``StepPhases`` for the
+  train loop's data-wait / dispatch / device-block breakdown, with an
+  optional bounded Chrome-trace recorder.
+* ``profiler`` — on-demand ``jax.profiler`` capture (SIGUSR2 or
+  programmatic) written under ``model_path``.
+
+Config knobs: ``telemetry_*`` in docs/CONFIG.md.  The train hot path makes
+ZERO registry calls unless ``telemetry_enabled`` is set; rare-event layers
+(storage retries, checkpoint IO, serving decode rounds) record always —
+their cadence is storage/request-bound, never per-step.
+"""
+from .profiler import OnDemandProfiler
+from .registry import (DEFAULT_BUCKETS, Registry, histogram_quantile,
+                       jsonl_line, merge_snapshots, prometheus_text,
+                       registry, render_json, set_registry, snapshot,
+                       summarize)
+from .spans import SPAN_METRIC, ChromeTrace, Phase, StepPhases, span
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Registry", "histogram_quantile", "jsonl_line",
+    "merge_snapshots", "prometheus_text", "registry", "render_json",
+    "set_registry", "snapshot", "summarize",
+    "SPAN_METRIC", "ChromeTrace", "Phase", "StepPhases", "span",
+    "OnDemandProfiler",
+]

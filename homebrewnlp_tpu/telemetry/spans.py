@@ -1,0 +1,122 @@
+"""Span API: ``with span("train/data_wait"): ...`` feeds the registry's
+span histogram and (optionally) a bounded Chrome-trace recorder, so one
+``chrome://tracing`` / Perfetto load shows where a slow step actually went.
+
+Stdlib-only, like the registry.  The train loop's per-step phases bypass
+the context-manager form for the three hottest sites (pre-bound ``Phase``
+handles, run/train_loop.py) — same histogram, fewer allocations.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import typing
+
+# NOT `from . import registry`: the package __init__ rebinds its `registry`
+# attribute to the registry() FUNCTION, shadowing the submodule
+from .registry import Registry, registry as _process_registry
+
+#: one histogram for every span, labelled by span name — span names may
+#: contain '/', which is legal in a label value but not a metric name
+SPAN_METRIC = "hbnlp_span_seconds"
+
+
+class ChromeTrace:
+    """Bounded ring buffer of span events, dumped as Chrome-trace JSON
+    (the ``[{"ph": "X", ...}]`` array form Perfetto and chrome://tracing
+    load directly).  Bounded so a long run cannot grow host memory without
+    limit — the LAST ``max_events`` spans survive."""
+
+    def __init__(self, max_events: int = 100_000):
+        self._events: typing.Deque[tuple] = collections.deque(
+            maxlen=max(1, int(max_events)))
+        self._lock = threading.Lock()
+
+    def add(self, name: str, start_s: float, duration_s: float):
+        with self._lock:
+            self._events.append((name, threading.get_ident(), start_s,
+                                 duration_s))
+
+    def __len__(self):
+        return len(self._events)
+
+    def events(self) -> typing.List[dict]:
+        with self._lock:
+            items = list(self._events)
+        return [{"name": name, "ph": "X", "pid": 0, "tid": tid,
+                 "ts": round(start * 1e6, 3), "dur": round(dur * 1e6, 3)}
+                for name, tid, start, dur in items]
+
+    def dump(self, path: str) -> str:
+        """Write the trace under ``path`` (any fs-seam scheme, so it lands
+        next to checkpoints on remote model_paths)."""
+        from ..utils import fs
+        with fs.open_(path, "w") as f:
+            json.dump(self.events(), f)
+        return path
+
+
+class Phase:
+    """A pre-bound span target: one histogram child + optional trace.
+    ``rec(t0, dt)`` is the whole hot-path cost — call sites own the clock
+    so a disabled run makes zero clock reads AND zero registry calls."""
+
+    __slots__ = ("_child", "_trace", "name")
+
+    def __init__(self, name: str, registry: typing.Optional[Registry] = None,
+                 trace: typing.Optional[ChromeTrace] = None):
+        r = registry if registry is not None else _process_registry()
+        self._child = r.histogram(
+            SPAN_METRIC, "span / step-phase duration in seconds",
+            ("span",)).labels(name)
+        self._trace = trace
+        self.name = name
+
+    def rec(self, start_s: float, duration_s: float):
+        self._child.observe(duration_s)
+        if self._trace is not None:
+            self._trace.add(self.name, start_s, duration_s)
+
+
+class _Span:
+    __slots__ = ("_phase", "_clock", "_t0")
+
+    def __init__(self, phase: Phase, clock):
+        self._phase = phase
+        self._clock = clock
+
+    def __enter__(self):
+        self._t0 = self._clock()
+        return self
+
+    def __exit__(self, *exc):
+        self._phase.rec(self._t0, self._clock() - self._t0)
+        return False
+
+
+def span(name: str, registry: typing.Optional[Registry] = None,
+         trace: typing.Optional[ChromeTrace] = None,
+         clock: typing.Callable[[], float] = time.monotonic) -> _Span:
+    """Context manager timing a block into the span histogram:
+    ``with span("ckpt/save"): ...``.  For per-step hot paths prefer a
+    pre-bound ``Phase`` (this form pays a metric + child lookup per call,
+    fine at checkpoint/request cadence)."""
+    return _Span(Phase(name, registry, trace), clock)
+
+
+class StepPhases:
+    """The train loop's step-phase breakdown: pre-bound Phase handles for
+    data-wait (blocked on the prefetcher), dispatch (host tracing +
+    enqueue of the jitted step), and device-block (waiting for the device
+    to finish the step) — the three-way split that tells data stalls from
+    host overhead from device time (docs/OBSERVABILITY.md)."""
+
+    def __init__(self, registry: typing.Optional[Registry] = None,
+                 trace: typing.Optional[ChromeTrace] = None,
+                 prefix: str = "train"):
+        self.data_wait = Phase(f"{prefix}/data_wait", registry, trace)
+        self.dispatch = Phase(f"{prefix}/dispatch", registry, trace)
+        self.device_block = Phase(f"{prefix}/device_block", registry, trace)
+        self.trace = trace

@@ -38,6 +38,30 @@ from ..utils import retry as retry_mod
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)$")
 
+# -- telemetry (docs/OBSERVABILITY.md) ----------------------------------------
+# Checkpoint IO records unconditionally: save/restore run at checkpoint
+# cadence (minutes apart), never on the step hot path, and the byte/duration
+# series are exactly what a stalled-upload or shrinking-throughput
+# investigation needs.  Metrics are created lazily ONCE.
+_metrics_cache: typing.Optional[tuple] = None
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from ..telemetry import registry as _reg
+        r = _reg()
+        _metrics_cache = (
+            r.counter("hbnlp_checkpoint_bytes_total",
+                      "array bytes moved through the checkpoint fs seam",
+                      ("op",)),
+            r.histogram("hbnlp_checkpoint_seconds",
+                        "wall seconds per checkpoint operation", ("op",)),
+            r.counter("hbnlp_checkpoint_crc_failures_total",
+                      "array files that failed length/crc verification"),
+        )
+    return _metrics_cache
+
 
 class CheckpointError(Exception):
     """A specific checkpoint is corrupt, truncated, or incomplete.  Carries
@@ -59,7 +83,7 @@ def _with_retry(path, thunk):
     hangs per op during an outage."""
     if getattr(fs.for_path(str(path)), "retries_internally", False):
         return thunk()
-    return retry_mod.default_policy().call(thunk)
+    return retry_mod.default_policy().call(thunk, site="checkpoint")
 
 
 def _fsop(fn, *args):
@@ -72,13 +96,16 @@ def _write_bytes(path: str, data: bytes) -> None:
         with fs.open_(path, "wb") as f:
             f.write(data)
     _with_retry(path, attempt)
+    _metrics()[0].labels(op="write").inc(len(data))
 
 
 def _read_bytes(path: str) -> bytes:
     def attempt():
         with fs.open_(path, "rb") as f:
             return f.read()
-    return _with_retry(path, attempt)
+    data = _with_retry(path, attempt)
+    _metrics()[0].labels(op="read").inc(len(data))
+    return data
 
 
 def _write_json(path: str, obj) -> None:
@@ -108,6 +135,7 @@ def _verify_bytes(data: bytes, meta: dict, ctx: str, ckpt_dir: str) -> None:
     verification — restore stays backward compatible."""
     want_len = meta.get("bytes")
     if want_len is not None and len(data) != int(want_len):
+        _metrics()[2].inc()
         raise CheckpointError(
             f"checkpoint {ckpt_dir}: {ctx} is truncated "
             f"({len(data)} bytes, manifest records {want_len})", ckpt_dir)
@@ -126,6 +154,7 @@ def _verify_bytes(data: bytes, meta: dict, ctx: str, ckpt_dir: str) -> None:
     else:
         got = zlib.crc32(data) & 0xFFFFFFFF
     if int(got) != int(want_crc):
+        _metrics()[2].inc()
         raise CheckpointError(
             f"checkpoint {ckpt_dir}: {ctx} fails {algo} verification "
             f"(stored {want_crc}, computed {got})", ckpt_dir)
@@ -205,6 +234,17 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
     together; everything else is written by the chief.  The directory rename
     is barriered so the checkpoint only becomes visible when all processes
     have flushed their shards."""
+    import time as _time
+    t_save = _time.monotonic()
+    try:
+        return _save_inner(model_path, step, variables, opt_state, max_keep,
+                           extra)
+    finally:
+        _metrics()[1].labels(op="save").observe(_time.monotonic() - t_save)
+
+
+def _save_inner(model_path: str, step: int, variables, opt_state,
+                max_keep: int, extra: typing.Optional[dict]) -> str:
     nproc = jax.process_count()
     if nproc > 1:
         return _save_distributed(model_path, step, variables, opt_state,
@@ -381,9 +421,14 @@ def restore(model_path: str, step: typing.Optional[int] = None
         if not steps:
             return None
         step = steps[-1]
+    import time as _time
+    t_restore = _time.monotonic()
     ckpt_dir = fs.join(model_path, f"ckpt_{int(step)}")
     try:
-        return _restore_verified(ckpt_dir)
+        out = _restore_verified(ckpt_dir)
+        _metrics()[1].labels(op="restore").observe(
+            _time.monotonic() - t_restore)
+        return out
     except CheckpointError:
         raise
     except (FileNotFoundError, json.JSONDecodeError, UnicodeDecodeError,

@@ -74,20 +74,27 @@ class MetricLogger:
     #: O(n^2) bytes over a run
     REMOTE_FLUSH_S = 30.0
 
-    def __init__(self, model_path: str, enable_tb: bool = True):
+    def __init__(self, model_path: str, enable_tb: bool = True,
+                 clock: typing.Callable[[], float] = time.monotonic):
         self.model_path = model_path
         fs.makedirs(model_path)
         self.jsonl = fs.open_(fs.join(model_path, "metrics.jsonl"), "a")
         self.tb = SummaryWriter(model_path) if enable_tb else None
-        self._t0 = time.time()
+        # elapsed-time arithmetic (steps_per_sec, wall, the flush cadence)
+        # runs on a monotonic clock: an NTP step of time.time() mid-run
+        # produced negative steps_per_sec points that corrupted the JSONL
+        # trajectory (wall-clock stamps stay time.time, where they belong)
+        self._clock = clock
+        self._t0 = self._clock()
         self._last_step_time = self._t0
         self._last_step = None
         self._local = fs.is_local(model_path)
         self._last_flush = 0.0
+        self._closed = False
 
     def log(self, step: int, metrics: typing.Dict[str, typing.Any],
             tokens_per_step: typing.Optional[int] = None):
-        now = time.time()
+        now = self._clock()
         vals = {k: float(v) for k, v in metrics.items()}
         if self._last_step is not None and step > self._last_step:
             dt = now - self._last_step_time
@@ -102,15 +109,24 @@ class MetricLogger:
             for k, v in vals.items():
                 self.tb.scalar(k, v, step)
         if self._local or now - self._last_flush > self.REMOTE_FLUSH_S:
-            self.jsonl.flush()
-            if self.tb is not None:
-                self.tb.flush()
+            self.flush()
             self._last_flush = now
         stamp = time.strftime("%H:%M:%S")
         parts = " ".join(f"{k}={v:.5g}" for k, v in vals.items())
         print(f"\x1b[32;1m[{stamp}]\x1b[0m step={step} {parts}", flush=True)
 
+    def flush(self):
+        self.jsonl.flush()
+        if self.tb is not None:
+            self.tb.flush()
+
     def close(self):
+        # idempotent: the emergency-shutdown path flushes/closes eagerly
+        # BEFORE the (possibly hanging) emergency checkpoint, and the normal
+        # teardown close must then be a no-op instead of a double-close error
+        if self._closed:
+            return
+        self._closed = True
         self.jsonl.close()
         if self.tb is not None:
             self.tb.close()

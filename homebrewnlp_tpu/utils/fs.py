@@ -275,7 +275,7 @@ class GCSFS(_ObjectStoreFS):
     @staticmethod
     def _retry(fn, *args):
         from . import retry
-        return retry.default_policy().call(fn, *args)
+        return retry.default_policy().call(fn, *args, site="gcs")
 
     def _split(self, key):
         rest = key[len("gs://"):]

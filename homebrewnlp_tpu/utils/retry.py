@@ -100,18 +100,53 @@ class RetryPolicy:
         base = min(self.max_delay, self.base_delay * self.multiplier ** attempt)
         return base * (1.0 + self.jitter * self.rng.random())
 
-    def call(self, fn: typing.Callable, *args, **kwargs):
+    def call(self, fn: typing.Callable, *args, site: str = "storage",
+             **kwargs):
         """Run ``fn(*args, **kwargs)``, retrying transient failures up to the
-        attempt budget.  The last error (or any permanent error) re-raises."""
+        attempt budget.  The last error (or any permanent error) re-raises.
+
+        ``site`` (keyword-only, reserved — never forwarded to ``fn``) labels
+        the failure-event counters this seam records into the telemetry
+        registry: ``hbnlp_storage_retries_total`` per transient retry,
+        ``hbnlp_storage_failures_total{kind=permanent|exhausted}`` when an
+        error surfaces.  The happy path records nothing — one failure-free
+        call costs zero registry calls."""
         attempt = 0
         while True:
             try:
                 return fn(*args, **kwargs)
             except Exception as e:
-                if attempt >= self.max_attempts - 1 or not self.classify(e):
+                transient = self.classify(e)
+                if attempt >= self.max_attempts - 1 or not transient:
+                    _record_failure(site,
+                                    "exhausted" if transient else "permanent")
                     raise
+                _record_retry(site)
                 self.sleep(self.backoff(attempt))
                 attempt += 1
+
+def _record_retry(site: str) -> None:
+    # failure-path only (guarded above): a metric bug must never turn a
+    # recoverable storage blip into a crash
+    try:
+        from ..telemetry import registry as _reg
+        _reg().counter("hbnlp_storage_retries_total",
+                       "transient storage errors that were retried",
+                       ("site",)).labels(site=site).inc()
+    except Exception:
+        pass
+
+
+def _record_failure(site: str, kind: str) -> None:
+    try:
+        from ..telemetry import registry as _reg
+        _reg().counter("hbnlp_storage_failures_total",
+                       "storage errors that surfaced to the caller "
+                       "(permanent, or transient with the budget exhausted)",
+                       ("site", "kind")).labels(site=site, kind=kind).inc()
+    except Exception:
+        pass
+
 
 _default: typing.Optional[RetryPolicy] = None
 

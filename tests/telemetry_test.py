@@ -1,0 +1,515 @@
+"""Telemetry subsystem (marker: telemetry; docs/OBSERVABILITY.md).
+
+Unit sweep: registry semantics, Prometheus text-exposition conformance
+(rendered text is parsed BACK and checked against the snapshot), histogram
+bucket accounting under concurrent writers, snapshot merge/JSONL/summary
+renderers, span -> histogram + chrome trace, the on-demand profiler state
+machine, the MetricLogger monotonic-clock fix, and the per-layer wiring
+(prefetcher, retry sites, checkpoint IO).
+
+Integration sweep: a train smoke run emitting the data-wait / dispatch /
+device-block step-phase breakdown (and ZERO phase series when
+``telemetry_enabled`` is false), SIGUSR2-triggered profile capture, and —
+device-free, on the serving_robustness_test harness — ``GET /metrics``
+answering valid exposition from the HTTP child while the device loop is
+wedged inside a decode."""
+import json
+import math
+import os
+import re
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu import telemetry
+from homebrewnlp_tpu.config import ModelParameter
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = telemetry.set_registry(telemetry.Registry())
+    yield telemetry.registry()
+    telemetry.set_registry(prev)
+
+
+# ---------------------------------------------------------------- unit sweep
+
+def registry_basics_test():
+    r = telemetry.Registry()
+    c = r.counter("c_total", "a counter", ("site",))
+    c.labels(site="gcs").inc()
+    c.labels("gcs").inc(2.5)        # positional and kwargs name the same series
+    with pytest.raises(ValueError):
+        c.labels(site="gcs").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc()                       # labelled metric needs labels()
+    g = r.gauge("g")
+    g.set(3)
+    g.set(1.5)
+    h = r.histogram("h_seconds", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(1.0)   # le is INCLUSIVE: lands in the 1.0 bucket
+    h.observe(99.0)  # +Inf bucket
+    with pytest.raises(TypeError):
+        g.observe(1.0)
+    with pytest.raises(ValueError):
+        r.counter("g")  # kind mismatch on re-registration
+    snap = r.snapshot()
+    assert snap["c_total"]["series"][("gcs",)] == 3.5
+    assert snap["g"]["series"][()] == 1.5
+    assert snap["h_seconds"]["series"][()]["counts"] == [2, 0, 1]
+    assert snap["h_seconds"]["series"][()]["sum"] == pytest.approx(100.5)
+    # same name + kind returns the same metric (idempotent registration)
+    assert r.counter("c_total", labelnames=("site",)) is c
+
+
+def _parse_exposition(text: str):
+    """Minimal conformance parser for the text format: returns
+    ({name: kind}, {(name, labelstring): value}) and asserts line shape."""
+    types, series = {}, {}
+    for line in text.strip().split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+        elif line.startswith("#"):
+            assert line.startswith("# HELP "), line
+        else:
+            m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                         r"(?:\{(.*)\})? (\S+)$", line)
+            assert m, f"malformed sample line: {line!r}"
+            name, labels, value = m.groups()
+            series[(name, labels or "")] = float(value)
+    return types, series
+
+
+def prometheus_exposition_conformance_test():
+    """Render -> parse back -> the parsed samples match the snapshot:
+    counter/gauge values, INCLUSIVE cumulative histogram buckets, +Inf
+    bucket == _count, _sum, and label-value escaping."""
+    r = telemetry.Registry()
+    r.counter("req_total", "requests", ("path", "code")) \
+        .labels(path="/x", code="200").inc(7)
+    r.gauge("depth", "queue depth").set(4)
+    weird = 'a"b\\c\nd'
+    r.counter("esc_total", "escaping", ("v",)).labels(v=weird).inc()
+    h = r.histogram("lat_seconds", "latency", ("op",), buckets=(0.1, 1, 10))
+    for v in (0.05, 0.1, 0.5, 3.0, 99.0):
+        h.labels(op="read").observe(v)
+    text = telemetry.prometheus_text(r.snapshot())
+    types, series = _parse_exposition(text)
+    assert types == {"req_total": "counter", "depth": "gauge",
+                     "esc_total": "counter", "lat_seconds": "histogram"}
+    assert series[("req_total", 'path="/x",code="200"')] == 7
+    assert series[("depth", "")] == 4
+    # escaped label value appears exactly per the format rules
+    assert ('esc_total', 'v="a\\"b\\\\c\\nd"') in series
+    # cumulative buckets: 0.1 is inclusive (2 of 0.05,0.1), then 3 <= 1, etc.
+    assert series[("lat_seconds_bucket", 'op="read",le="0.1"')] == 2
+    assert series[("lat_seconds_bucket", 'op="read",le="1"')] == 3
+    assert series[("lat_seconds_bucket", 'op="read",le="10"')] == 4
+    assert series[("lat_seconds_bucket", 'op="read",le="+Inf"')] == 5
+    assert series[("lat_seconds_count", 'op="read"')] == 5
+    assert series[("lat_seconds_sum", 'op="read"')] == pytest.approx(102.65)
+    cum = [series[("lat_seconds_bucket", f'op="read",le="{b}"')]
+           for b in ("0.1", "1", "10", "+Inf")]
+    assert cum == sorted(cum), "bucket counts must be cumulative-monotone"
+
+
+def histogram_concurrent_writers_test():
+    """Bucket accounting stays exact under concurrent writers: total count,
+    per-bucket sums, and the sum of observations all reconcile."""
+    r = telemetry.Registry()
+    h = r.histogram("conc_seconds", buckets=(0.25, 0.5, 0.75))
+    c = r.counter("conc_total")
+    threads, per_thread = 8, 2000
+    values = [i / per_thread for i in range(per_thread)]  # 0 .. 0.9995
+
+    def work():
+        child = r.histogram("conc_seconds").labels()
+        for v in values:
+            child.observe(v)
+            c.inc()
+
+    ts = [threading.Thread(target=work) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = r.snapshot()
+    state = snap["conc_seconds"]["series"][()]
+    n = threads * per_thread
+    assert sum(state["counts"]) == n
+    assert snap["conc_total"]["series"][()] == n
+    # each quarter-bucket holds exactly threads * per_thread/4 observations
+    # (le inclusive: 0.25 itself lands in the first bucket)
+    expect = threads * (per_thread // 4)
+    assert state["counts"] == [expect + threads, expect, expect,
+                               expect - threads]
+    assert state["sum"] == pytest.approx(threads * sum(values))
+
+
+def merge_and_render_test():
+    ra, rb = telemetry.Registry(), telemetry.Registry()
+    ra.counter("n_total").inc(2)
+    rb.counter("n_total").inc(3)
+    ra.gauge("g").set(1)
+    rb.gauge("g").set(9)
+    ha = ra.histogram("h", buckets=(1,))
+    hb = rb.histogram("h", buckets=(1,))
+    ha.observe(0.5)
+    hb.observe(2.0)
+    merged = telemetry.merge_snapshots(ra.snapshot(), rb.snapshot())
+    assert merged["n_total"]["series"][()] == 5     # counters sum
+    assert merged["g"]["series"][()] == 9           # gauges: later wins
+    assert merged["h"]["series"][()]["counts"] == [1, 1]
+    assert merged["h"]["series"][()]["sum"] == 2.5
+    # JSONL line round-trips through json with flat series keys
+    line = telemetry.jsonl_line(merged, step=7)
+    doc = json.loads(line)
+    assert doc["step"] == 7
+    assert doc["metrics"]["n_total"]["series"][""] == 5
+    assert doc["metrics"]["h"]["series"][""]["count"] == 2
+    # summarize: flat keys, histogram medians
+    summary = telemetry.summarize(merged)
+    assert summary["n_total"] == 5
+    assert summary["h"]["count"] == 2 and summary["h"]["p50"] == 1.0
+    assert telemetry.histogram_quantile((1.0,), [0, 0], 0.5) is None
+
+
+def span_and_chrome_trace_test():
+    r = telemetry.Registry()
+    trace = telemetry.ChromeTrace(max_events=3)
+    clock = iter([1.0, 1.25]).__next__
+    with telemetry.span("ckpt/save", r, trace, clock=clock):
+        pass
+    snap = r.snapshot()
+    state = snap[telemetry.SPAN_METRIC]["series"][("ckpt/save",)]
+    assert sum(state["counts"]) == 1 and state["sum"] == pytest.approx(0.25)
+    for i in range(5):  # bounded: only the last 3 survive (ckpt/save evicted)
+        trace.add(f"s{i}", float(i), 0.5)
+    events = trace.events()
+    assert [e["name"] for e in events] == ["s2", "s3", "s4"]
+    assert events[0]["ph"] == "X" and events[0]["dur"] == 500000.0
+    phases = telemetry.StepPhases(registry=r, trace=trace)
+    phases.device_block.rec(9.0, 0.125)
+    assert snap is not r.snapshot()  # snapshot is a copy, not a live view
+    got = r.snapshot()[telemetry.SPAN_METRIC]["series"]
+    assert ("train/device_block",) in got
+
+
+def on_demand_profiler_test(tmp_path):
+    calls = []
+    p = telemetry.OnDemandProfiler(str(tmp_path), capture_steps=3,
+                                   start=lambda d: calls.append(("start", d)),
+                                   stop=lambda: calls.append(("stop",)))
+    p.poll(0)
+    assert calls == []          # nothing requested: zero cost
+    p.request()
+    p.poll(10)                  # starts at the next poll
+    assert p.active and calls == [("start", str(tmp_path) + "/on_demand_10")]
+    p.poll(11)
+    p.poll(12)
+    assert p.active             # 10 + 3 not reached
+    p.poll(13)
+    assert not p.active and calls[-1] == ("stop",)
+    p.request()
+    p.poll(20)
+    p.request()                 # second request while active = stop early
+    p.poll(21)
+    assert not p.active and calls[-1] == ("stop",)
+    # a failing start is reported, never fatal, and leaves it inactive
+    boom = telemetry.OnDemandProfiler(
+        str(tmp_path), start=lambda d: (_ for _ in ()).throw(RuntimeError()))
+    boom.request()
+    boom.poll(0)
+    assert not boom.active
+
+
+def metric_logger_monotonic_test(tmp_path):
+    """steps_per_sec comes off an injectable monotonic clock: a wall-clock
+    step (NTP) between logs can no longer produce negative rates."""
+    from homebrewnlp_tpu.train.metrics import MetricLogger
+    t = [100.0]
+    logger = MetricLogger(str(tmp_path), enable_tb=False,
+                          clock=lambda: t[0])
+    logger.log(1, {"loss": 1.0}, tokens_per_step=10)
+    t[0] += 2.0
+    logger.log(3, {"loss": 0.9}, tokens_per_step=10)
+    logger.flush()
+    logger.close()
+    logger.close()  # idempotent: the emergency path closes eagerly
+    lines = [json.loads(x) for x in
+             open(os.path.join(tmp_path, "metrics.jsonl"))]
+    assert "steps_per_sec" not in lines[0]
+    assert lines[1]["steps_per_sec"] == pytest.approx(1.0)
+    assert lines[1]["tokens_per_sec"] == pytest.approx(10.0)
+    assert lines[1]["wall"] == pytest.approx(2.0)
+
+
+def prefetcher_telemetry_gating_test(fresh_registry):
+    from homebrewnlp_tpu.data.inputs import Prefetcher
+    # no label (the telemetry_enabled=false path): ZERO registry calls
+    list(Prefetcher(iter(range(4)), depth=2))
+    assert fresh_registry.snapshot() == {}
+    out = list(Prefetcher(iter(range(5)), depth=2, telemetry_label="train"))
+    assert out == list(range(5))
+    snap = fresh_registry.snapshot()
+    assert snap["hbnlp_prefetch_items_total"]["series"][("train",)] == 5
+    assert ("train",) in snap["hbnlp_prefetch_queue_depth"]["series"]
+
+
+def retry_site_counters_test(fresh_registry):
+    from homebrewnlp_tpu.utils.retry import RetryPolicy, TransientError
+    policy = RetryPolicy(max_attempts=3, base_delay=0.0, sleep=lambda s: None)
+    boom = [0]
+
+    def flaky():
+        boom[0] += 1
+        if boom[0] < 3:
+            raise TransientError("blip")
+        return "ok"
+
+    assert policy.call(flaky, site="gcs") == "ok"
+    with pytest.raises(FileNotFoundError):
+        policy.call(lambda: (_ for _ in ()).throw(FileNotFoundError("x")),
+                    site="checkpoint")
+    with pytest.raises(TransientError):
+        policy.call(lambda: (_ for _ in ()).throw(TransientError("down")),
+                    site="gcs")
+    snap = fresh_registry.snapshot()
+    assert snap["hbnlp_storage_retries_total"]["series"][("gcs",)] == 4
+    fails = snap["hbnlp_storage_failures_total"]["series"]
+    assert fails[("checkpoint", "permanent")] == 1
+    assert fails[("gcs", "exhausted")] == 1
+
+
+def checkpoint_io_metrics_test(tmp_path, fresh_registry, monkeypatch):
+    """Checkpoint saves/restores record bytes, durations, and crc failures
+    into the registry (always on — checkpoint cadence, not the hot path)."""
+    from homebrewnlp_tpu.train import checkpoint as ckpt
+    monkeypatch.setattr(ckpt, "_metrics_cache", None)  # rebind to fresh reg
+    variables = {"w": np.arange(8, dtype=np.float32)}
+    opt = {"m": {"w": np.zeros(8, np.float32)}}
+    d = str(tmp_path / "run")
+    ckpt.save(d, 3, variables, opt, max_keep=2)
+    restored = ckpt.restore(d)
+    assert restored is not None and restored[2] == 3
+    snap = fresh_registry.snapshot()
+    per_op = snap["hbnlp_checkpoint_bytes_total"]["series"]
+    assert per_op[("write",)] >= 64 and per_op[("read",)] >= 64
+    secs = snap["hbnlp_checkpoint_seconds"]["series"]
+    assert sum(secs[("save",)]["counts"]) == 1
+    assert sum(secs[("restore",)]["counts"]) == 1
+    # flip one payload byte -> crc failure counter + CheckpointError
+    target = os.path.join(d, "ckpt_3", "arr_000000.bin")
+    blob = bytearray(open(target, "rb").read())
+    blob[0] ^= 0xFF
+    open(target, "wb").write(bytes(blob))
+    with pytest.raises(ckpt.CheckpointError, match="verification"):
+        ckpt.restore(d)
+    snap = fresh_registry.snapshot()
+    assert snap["hbnlp_checkpoint_crc_failures_total"]["series"][()] == 1
+
+
+# -------------------------------------------------------- integration sweep
+
+def train_step_phase_breakdown_test(tmp_path, fresh_registry):
+    """Tentpole acceptance: with telemetry on, a train smoke run emits the
+    data-wait / dispatch / device-block step-phase breakdown, prefetcher
+    series, a telemetry.jsonl trajectory and a chrome trace; with it off,
+    the registry sees ZERO calls from the whole run."""
+    from robustness_test import _train_cfg, _write_records
+    from homebrewnlp_tpu.run import train_loop as tl
+
+    data_dir = _write_records(tmp_path)
+    cfg = _train_cfg(tmp_path, data_dir, use_checkpointing=False)
+    result = tl.train(ModelParameter(cfg), log_every=2)
+    assert result["final_step"] == cfg["train_steps"]
+    assert fresh_registry.snapshot() == {}, \
+        "telemetry_enabled=false must make zero registry calls"
+
+    cfg = _train_cfg(tmp_path, data_dir, use_checkpointing=False,
+                     model_path=str(tmp_path / "run2"),
+                     telemetry_enabled=True,
+                     telemetry_jsonl_interval_s=1e-6,
+                     telemetry_chrome_trace_events=1000)
+    result = tl.train(ModelParameter(cfg), log_every=2)
+    assert result["final_step"] == cfg["train_steps"]
+    snap = fresh_registry.snapshot()
+    spans = snap[telemetry.SPAN_METRIC]["series"]
+    steps = cfg["train_steps"]
+    for phase in ("train/data_wait", "train/dispatch", "train/device_block"):
+        state = spans[(phase,)]
+        # first_batch is fetched before the loop: data_wait sees steps - 1
+        assert sum(state["counts"]) >= steps - 1, phase
+        assert state["sum"] >= 0
+    assert snap["hbnlp_prefetch_items_total"]["series"][("train",)] >= steps
+    # the JSONL trajectory parses and carries the span series
+    jsonl = os.path.join(cfg["model_path"], "telemetry.jsonl")
+    lines = [json.loads(x) for x in open(jsonl)]
+    assert lines and telemetry.SPAN_METRIC in lines[-1]["metrics"]
+    assert lines[-1]["step"] == steps
+    # the chrome trace is valid and its spans carry durations
+    trace = json.load(open(os.path.join(cfg["model_path"],
+                                        "telemetry_trace.json")))
+    assert len(trace) >= 3 * (steps - 1)
+    assert {e["name"] for e in trace} >= {"train/data_wait",
+                                          "train/dispatch",
+                                          "train/device_block"}
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in trace)
+
+
+def sigusr2_profile_capture_test(tmp_path, fresh_registry, monkeypatch):
+    """telemetry_profile_on_signal: SIGUSR2 mid-run starts a jax.profiler
+    capture at the next loop tick and stops it telemetry_profile_steps
+    steps later, under <model_path>/profile/on_demand_<step>."""
+    import jax
+    from robustness_test import _train_cfg, _write_records
+    import homebrewnlp_tpu.train.metrics as metrics_mod
+    from homebrewnlp_tpu.run import train_loop as tl
+
+    captures = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d, **k: captures.append(["start", d]))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: captures.append(["stop"]))
+    orig_log = metrics_mod.MetricLogger.log
+    fired = []
+
+    def log_then_signal(self, step, *a, **k):
+        orig_log(self, step, *a, **k)
+        if step >= 2 and not fired:
+            fired.append(step)
+            signal.raise_signal(signal.SIGUSR2)
+
+    monkeypatch.setattr(metrics_mod.MetricLogger, "log", log_then_signal)
+    cfg = _train_cfg(tmp_path, _write_records(tmp_path),
+                     use_checkpointing=False,
+                     telemetry_profile_on_signal=True,
+                     telemetry_profile_steps=2)
+    result = tl.train(ModelParameter(cfg), log_every=1)
+    assert result["final_step"] == cfg["train_steps"]
+    assert ["stop"] in captures
+    starts = [c for c in captures if c[0] == "start"]
+    assert len(starts) == 1
+    assert starts[0][1].startswith(os.path.join(cfg["model_path"],
+                                                "profile", "on_demand_"))
+    # the handler was uninstalled on the way out
+    assert signal.getsignal(signal.SIGUSR2) in (signal.SIG_DFL,
+                                                signal.default_int_handler)
+
+
+@pytest.mark.serving
+def metrics_endpoint_under_wedged_decode_test():
+    """Satellite acceptance: GET /metrics serves valid Prometheus text
+    exposition from the HTTP child WITHOUT crossing the device loop — it
+    answers (with admission counters, queue/breaker gauges, and the device
+    loop's decode histograms merged from the heartbeat-published snapshot)
+    while the device loop is wedged inside a decode."""
+    from serving_robustness_test import (_StubInterface, _post, _serve_params,
+                                         _spawn_serve)
+    from homebrewnlp_tpu.utils.fault_injection import FaultyInterface
+
+    params = _serve_params(serve_queue_limit=2, serve_batch_size=1,
+                           serve_breaker_threshold=0,
+                           serve_request_deadline_s=8.0)
+    release = threading.Event()
+    faulty = FaultyInterface(_StubInterface(params), block_on=release,
+                             block_at={1}, block_timeout_s=30.0)
+    port, stop, t = _spawn_serve(faulty)
+
+    def scrape():
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            return resp.read().decode()
+
+    try:
+        _post(port, "/health", {})     # wait for the server to come up
+        types, series = _parse_exposition(scrape())
+        assert types["hbnlp_serve_admission_total"] == "counter"
+        assert types["hbnlp_serve_queue_depth"] == "gauge"
+        assert types["hbnlp_serve_breaker_state"] == "gauge"
+        assert series[("hbnlp_serve_breaker_state", "")] == 0
+
+        # one successful decode -> the device loop's histograms reach the
+        # child through the published snapshot
+        status, out, _ = _post(port, "/token_completion", {"tokens": [1, 2]})
+        assert status == 200
+        deadline = time.monotonic() + 10
+        while True:   # published on the next device-loop poll
+            types, series = _parse_exposition(scrape())
+            if series.get(("hbnlp_serve_decode_seconds_count", "")):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        assert series[("hbnlp_serve_decode_calls_total", "")] >= 1
+        assert series[("hbnlp_serve_queue_wait_seconds_count", "")] >= 1
+        assert series[("hbnlp_serve_batch_size_count", "")] >= 1
+        assert series[("hbnlp_serve_admission_total",
+                       'decision="accepted"')] >= 1
+
+        # wedge the device loop inside a decode; /metrics must still answer
+        results = {}
+        th = threading.Thread(
+            target=lambda: results.update(
+                w=_post(port, "/token_completion", {"tokens": [3]},
+                        timeout=25)),
+            daemon=True)
+        th.start()
+        deadline = time.monotonic() + 10
+        while faulty.calls < 2:        # the wedged decode is now in flight
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        types, series = _parse_exposition(scrape())
+        assert time.monotonic() - t0 < 2.0, "scrape crossed the device loop"
+        assert series[("hbnlp_serve_admission_total",
+                       'decision="accepted"')] >= 2
+        # POST works too (text exposition, so not via the JSON _post helper)
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics",
+                                     data=b"{}",
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            _parse_exposition(resp.read().decode())
+        release.set()
+        th.join(timeout=15)
+        assert results["w"][0] == 200
+    finally:
+        release.set()
+        stop.set()
+        t.join(timeout=15)
+    assert not t.is_alive()
+
+
+def in_process_metrics_handler_test(fresh_registry):
+    """The non-isolated branch serves /metrics from the local registry via
+    the shared handlers table (no IPC state exists in-process)."""
+    from serving_robustness_test import _StubInterface, _serve_params
+    from homebrewnlp_tpu.infer import rest_api
+    import homebrewnlp_tpu.infer.rest_api as ra
+    # rebind the lazily-cached serve metrics to the fresh registry
+    prev = ra._SERVE_METRICS
+    ra._SERVE_METRICS = None
+    try:
+        stub = _StubInterface(_serve_params())
+        handlers = rest_api._handlers(stub)
+        handlers["/token_completion"]({"tokens": [1, 2]})
+        out = handlers["/metrics"]({})
+        types, series = _parse_exposition(out["_prometheus"])
+        assert types["hbnlp_serve_decode_seconds"] == "histogram"
+        assert series[("hbnlp_serve_decode_seconds_count", "")] == 1
+        assert series[("hbnlp_serve_tokens_per_second_count", "")] == 1
+    finally:
+        ra._SERVE_METRICS = prev
