@@ -79,7 +79,7 @@ def _ensure_live_backend():
 def main() -> int:
     _ensure_live_backend()
     import numpy as np
-    t_setup = time.time()
+    t_setup = time.monotonic()
     import jax
     import jax.numpy as jnp
     from homebrewnlp_tpu.config import ModelParameter
@@ -107,16 +107,16 @@ def main() -> int:
                     "token_y": jnp.asarray((x + 1) % params.vocab_size)}
 
         state = trainer.init_state(make_batch())
-        print(f"setup {time.time() - t_setup:.1f}s; compiling...",
+        print(f"setup {time.monotonic() - t_setup:.1f}s; compiling...",
               file=sys.stderr)
-        t_compile = time.time()
+        t_compile = time.monotonic()
         for _ in range(WARMUP_STEPS):
             state, metrics = trainer.step(state, make_batch())
         # sync by materialising the value: the axon tunnel's
         # block_until_ready can return before the dispatched chain has
         # executed; producing the float forces the chain to completion
         float(metrics["loss"])
-        print(f"compile+warmup {time.time() - t_compile:.1f}s",
+        print(f"compile+warmup {time.monotonic() - t_compile:.1f}s",
               file=sys.stderr)
         return params, trainer, state, make_batch
 
@@ -138,11 +138,11 @@ def main() -> int:
         params, trainer, state, make_batch = build(cfg)
 
     batches = [make_batch() for _ in range(MEASURE_STEPS)]
-    t0 = time.time()
+    t0 = time.monotonic()
     for batch in batches:
         state, metrics = trainer.step(state, batch)
     final_loss = float(metrics["loss"])  # value fetch = true device sync
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
 
     # step-phase attribution (docs/OBSERVABILITY.md): a short instrumented
     # pass so BENCH_* files carry data-wait / dispatch / device-block
@@ -213,6 +213,23 @@ def main() -> int:
         print(f"MFU computation failed: {exc}", file=sys.stderr)
         mfu_frac = mfu_causal = None
 
+    # collective census of the headline train step (docs/STATIC_ANALYSIS.md):
+    # BENCH_*.json tracks comms growth round over round the same way it
+    # tracks tokens/sec — an unexplained new collective kind in the trend is
+    # accidental resharding.  Needs a second compile of the step (the
+    # executed jit's compiled module is not retrievable), so it runs only
+    # where that is cheap (CPU fallback shapes) unless BENCH_COLLECTIVES=1
+    # forces it; BENCH_COLLECTIVES=0 disables it everywhere.
+    collectives = None
+    want = os.environ.get("BENCH_COLLECTIVES", "auto")
+    if want != "0" and (want != "auto" or jax.default_backend() == "cpu"):
+        try:
+            from homebrewnlp_tpu.analysis import hlo_lint
+            hlo = trainer.lowered(state, batches[0]).compile().as_text()
+            collectives = hlo_lint.collective_census(hlo)
+        except Exception as exc:
+            print(f"collective census failed: {exc}", file=sys.stderr)
+
     # first recorded value per backend becomes the baseline; later runs
     # report progress against it (batch size is part of the config identity
     # so an OOM-halved run never corrupts the full-batch baseline)
@@ -255,6 +272,8 @@ def main() -> int:
         out["val_loss"] = round(val_loss, 4)
     if telemetry_summary is not None:
         out["telemetry"] = telemetry_summary
+    if collectives is not None:
+        out["collectives"] = collectives
     # the headline line goes out NOW: the companion's 16k compile can kill
     # the PROCESS (worker crash / OOM), which no except clause survives — a
     # consumer taking the last JSON line sees the enriched line when the

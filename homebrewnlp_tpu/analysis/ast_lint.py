@@ -1,0 +1,276 @@
+"""Repo-specific AST lint rules (graft-lint half b).
+
+Source-level discipline over ``homebrewnlp_tpu/`` and ``scripts/`` —
+stdlib-only and importable WITHOUT the package (scripts/check_config_docs.py
+loads this file by path; nothing here may import numpy, jax, or siblings):
+
+==============  ============================================================
+rule            invariant
+==============  ============================================================
+wallclock       ``time.time()`` is forbidden — durations on an NTP-stepped
+                wall clock corrupted steps_per_sec (the PR 4 MetricLogger
+                bug); use ``time.monotonic()``.  Epoch stamps that genuinely
+                need wall time (tfevents wall_time, filename stamps) carry
+                an allow marker.
+unseeded-rng    ``np.random.default_rng()`` with no seed is unreproducible;
+                the two deliberate sites (shuffle entropy, data_seed
+                generation itself) carry allow markers.
+donated-jit     every ``jax.jit(..., donate_argnums=...)`` site must be
+                registered in ``DONATED_JIT_REGISTRY`` so the HLO donation
+                audit (analysis/hlo_lint.py) covers it — an unregistered
+                donation is an unaudited 2x-HBM failure mode.
+config-docs     every ModelParameter knob has a docs/CONFIG.md table row
+                (absorbed from scripts/check_config_docs.py, which now
+                shims onto this rule).
+==============  ============================================================
+
+Suppression: put ``graft-lint: allow[<rule>]`` in a comment on the
+offending line or the line above.  Suppressions are part of the diff and
+review like any other code.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import typing
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CONFIG_PY = os.path.join(REPO, "homebrewnlp_tpu", "config.py")
+CONFIG_MD = os.path.join(REPO, "docs", "CONFIG.md")
+
+#: source trees the repo rules run over (tests/ excluded: harness code
+#: times walls and seeds rngs per-test by its own conventions)
+LINT_SUBDIRS = ("homebrewnlp_tpu", "scripts")
+
+#: ``file::enclosing-function`` of every ``donate_argnums`` jit site,
+#: mapped to the HLO-audit entry point(s) covering it
+#: (analysis/entry_points.py).  Adding a donated jit?  Register it here AND
+#: give it a lowering + donation audit there — donation is a compiled-
+#: artifact property and regresses silently (docs/STATIC_ANALYSIS.md).
+DONATED_JIT_REGISTRY: typing.Dict[str, str] = {
+    # the donated train step: audited as "train_step"
+    "homebrewnlp_tpu/train/__init__.py::_build_step": "train_step",
+    # the stepped decode chunk + its cache-initialising first chunk:
+    # audited as "decode_chunk_step" and "prefill_entry_step"
+    "homebrewnlp_tpu/infer/sampler.py::_jit_sampler":
+        "decode_chunk_step, prefill_entry_step",
+    # the audit harness's own lowering of the decode step
+    "homebrewnlp_tpu/analysis/entry_points.py::lower_decode_step":
+        "decode_chunk_step (harness)",
+    "homebrewnlp_tpu/analysis/entry_points.py::lower_prefill_entry":
+        "prefill_entry_step (harness)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: ``rule``, ``entry`` (``relpath:line``), ``message``."""
+    rule: str
+    entry: str
+    message: str
+
+    def __str__(self):
+        return f"[{self.rule}] {self.entry}: {self.message}"
+
+
+def _suppressed(lines: typing.Sequence[str], lineno: int, rule: str) -> bool:
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and f"graft-lint: allow[{rule}]" in lines[ln - 1]:
+            return True
+    return False
+
+
+# ---- per-file rules --------------------------------------------------------
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression (``np.random.default_rng``)."""
+    parts: typing.List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _FileVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, lines: typing.Sequence[str]):
+        self.rel = rel
+        self.lines = lines
+        self.fn_stack: typing.List[str] = []
+        self.findings: typing.List[Finding] = []
+        #: names bound to the time MODULE (``import time [as t]``) and to
+        #: the time.time FUNCTION (``from time import time [as now]``) —
+        #: the wallclock rule must catch every spelling, not just
+        #: ``time.time()``
+        self.time_modules: typing.Set[str] = {"time"}
+        self.time_funcs: typing.Set[str] = set()
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if alias.name == "time":
+                self.time_modules.add(alias.asname or "time")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    self.time_funcs.add(alias.asname or "time")
+        self.generic_visit(node)
+
+    def _add(self, rule: str, node: ast.AST, message: str):
+        if not _suppressed(self.lines, node.lineno, rule):
+            self.findings.append(
+                Finding(rule, f"{self.rel}:{node.lineno}", message))
+
+    def visit_FunctionDef(self, node):
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_wallclock(self, name: str) -> bool:
+        mod, _, attr = name.rpartition(".")
+        return ((attr == "time" and mod in self.time_modules)
+                or (not mod and name in self.time_funcs))
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        if self._is_wallclock(name):
+            self._add("wallclock", node,
+                      "time.time() is wall clock — an NTP step corrupts "
+                      "elapsed-time arithmetic; use time.monotonic() for "
+                      "durations (epoch stamps: add a "
+                      "`graft-lint: allow[wallclock]` marker)")
+        elif name.endswith("default_rng") and not node.args and not node.keywords:
+            self._add("unseeded-rng", node,
+                      "np.random.default_rng() without a seed is "
+                      "unreproducible; seed it (params.data_seed / an "
+                      "explicit constant) or mark the line "
+                      "`graft-lint: allow[unseeded-rng]`")
+        elif name.split(".")[-1] in ("jit", "pjit") and any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in node.keywords):
+            fn = self.fn_stack[-1] if self.fn_stack else "<module>"
+            key = f"{self.rel}::{fn}"
+            if key not in DONATED_JIT_REGISTRY:
+                self._add("donated-jit", node,
+                          f"donated jit site {key!r} is not in "
+                          "analysis/ast_lint.py DONATED_JIT_REGISTRY — "
+                          "register it and give it an HLO donation audit "
+                          "(analysis/entry_points.py), or the donation can "
+                          "silently stop aliasing")
+        self.generic_visit(node)
+
+
+def lint_source(rel: str, source: str) -> typing.List[Finding]:
+    """Per-file rules over one source blob (``rel`` is the repo-relative
+    path used in findings and registry keys)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("parse", f"{rel}:{e.lineno}", f"syntax error: {e.msg}")]
+    visitor = _FileVisitor(rel, source.splitlines())
+    visitor.visit(tree)
+    return visitor.findings
+
+
+# ---- config-docs rule (absorbed from scripts/check_config_docs.py) ---------
+
+#: internal bookkeeping assigned in the defaults section that is NOT a
+#: config knob (everything else there is)
+INTERNAL = {"unknown_config_keys"}
+
+
+def config_knobs(source: str) -> typing.List[str]:
+    """``self.X = default`` names from ModelParameter.__init__, up to the
+    unknown-key update loop."""
+    tree = ast.parse(source)
+    init = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ModelParameter":
+            init = next(n for n in node.body
+                        if isinstance(n, ast.FunctionDef)
+                        and n.name == "__init__")
+            break
+    if init is None:
+        raise AssertionError("ModelParameter.__init__ not found")
+    knobs = []
+    for stmt in init.body:
+        if isinstance(stmt, ast.For):
+            # the `for k, v in config.items()` loop ends the defaults
+            # section; later assignments are validation/derivation
+            break
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        else:
+            continue
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self" and not t.attr.startswith("_")
+                    and t.attr not in INTERNAL):
+                knobs.append(t.attr)
+    if len(knobs) < 50:  # the reference schema alone has ~150
+        raise AssertionError(f"only {len(knobs)} knobs parsed — the "
+                             "defaults-section detection broke")
+    return knobs
+
+
+def documented_keys(md: str) -> typing.Set[str]:
+    """Keys of every ``| `name` | ...`` table row."""
+    return set(re.findall(r"^\|\s*`([A-Za-z_][A-Za-z_0-9]*)`", md, re.M))
+
+
+def missing_knobs(config_py: str = CONFIG_PY,
+                  config_md: str = CONFIG_MD) -> typing.List[str]:
+    with open(config_py) as f:
+        knobs = config_knobs(f.read())
+    with open(config_md) as f:
+        documented = documented_keys(f.read())
+    return sorted(set(k for k in knobs if k not in documented))
+
+
+def config_docs_findings(config_py: str = CONFIG_PY,
+                         config_md: str = CONFIG_MD) -> typing.List[Finding]:
+    return [Finding("config-docs", "docs/CONFIG.md",
+                    f"config knob `{k}` has no docs/CONFIG.md table row "
+                    "(add `| `" + k + "` | <default> | <meaning> |`)")
+            for k in missing_knobs(config_py, config_md)]
+
+
+# ---- repo walk -------------------------------------------------------------
+
+def iter_source_files(root: str = REPO,
+                      subdirs: typing.Sequence[str] = LINT_SUBDIRS
+                      ) -> typing.Iterator[typing.Tuple[str, str]]:
+    """Yield ``(abs_path, repo_relative_path)`` for every lintable .py."""
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    path = os.path.join(dirpath, fname)
+                    yield path, os.path.relpath(path, root)
+
+
+def lint_repo(root: str = REPO,
+              subdirs: typing.Sequence[str] = LINT_SUBDIRS,
+              config_docs: bool = True) -> typing.List[Finding]:
+    """All AST rules over the repo: per-file rules + the config-docs rule."""
+    findings: typing.List[Finding] = []
+    for path, rel in iter_source_files(root, subdirs):
+        with open(path) as f:
+            findings += lint_source(rel, f.read())
+    if config_docs:
+        findings += config_docs_findings(
+            os.path.join(root, "homebrewnlp_tpu", "config.py"),
+            os.path.join(root, "docs", "CONFIG.md"))
+    return findings

@@ -1,0 +1,297 @@
+"""Lowering registry: every jitted entry point the HLO passes audit.
+
+``infer/hlo_check.py`` audited ONE entry point (the decode chunk step);
+the train step (``train/__init__.py`` ``donate_argnums=(0,)``), the
+cache-initialising first decode chunk ("prefill entry"), and the eval fn
+were on the honor system.  This module builds a small audit model and
+lowers + compiles all four on the CURRENT backend — on TPU that audits the
+exact production executable; under the CPU rig it pins the structural
+properties (donation, aliasable carries, collective count, no host syncs)
+that the TPU compile inherits.
+
+Each ``lower_*`` returns ``(hlo_text, context)`` where ``context`` carries
+what the passes need: ``donated_leaves`` (expected alias count),
+``protected`` (shapes whose full-buffer copy is a regression), and
+``bf16_params`` for the dtype-promotion pass.  ``audit_all`` runs every
+pass over every entry point against ``analysis/budgets.json``.
+
+jax is imported inside functions only — importing this module stays cheap
+(and safe from the AST-only consumers of the package).
+"""
+from __future__ import annotations
+
+import typing
+
+from . import hlo_lint
+
+#: the audit model: small enough that all four compiles finish in seconds
+#: on one CPU, in bf16 so the dtype-promotion pass has teeth (a param-
+#: shaped f32 convert in a bf16 forward is an accidental master-weight
+#: copy).  Mirrors tests/backend.py's harness config.
+AUDIT_CONFIG: typing.Dict[str, typing.Any] = {
+    "model_mode": "gpt", "use_video": False, "use_language": True,
+    "sequence_length": 16, "features_per_head": 16, "heads": 2,
+    "depth": 2, "train_batch_size": 4, "vocab_size": 32,
+    "group_linear_factor": 2,
+    "intermediate_feed_forward_multiplier_multiplier": 0.5,
+    "calculation_dtype": "bfloat16", "storage_dtype": "bfloat16",
+    "memory_reduction_strategy": "none",
+    # the flagship optimizer chain (bench.py): its sm3/momentum slots put
+    # real optimizer state into the donated carry, so the donation audit
+    # covers opt-state aliasing too, not just params
+    "optimizer": "adaptive_clip:0.003-sm3-momentum:0.9:1:1-learning_rate",
+    "block_config": [
+        {"layer": ["norm-shift-scale-features-group",
+                   "bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:"
+                   "shift-mid:scale-mid:features"]},
+        {"layer": ["norm-shift-scale-features-group",
+                   "attention-biased_attention_map-absolute-input_as_value-"
+                   "shared",
+                   "norm-shift-scale-features-group", "activation-gelu",
+                   "attention-biased_attention_map-absolute-input_as_value-"
+                   "shared"]}],
+}
+
+#: audited entry points, in budgets.json key order
+ENTRY_POINTS = ("train_step", "decode_chunk_step", "prefill_entry_step",
+                "eval_fn")
+
+
+def build_audit_model(overrides: typing.Optional[dict] = None, seed: int = 0):
+    """(params, model, variables, token_x, batch) at the audit config."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import ModelParameter
+    from ..model import Model
+
+    cfg = dict(AUDIT_CONFIG)
+    cfg.update(overrides or {})
+    params = ModelParameter(cfg)
+    model = Model(params)
+    rng = np.random.default_rng(seed)
+    seq = params.sequence_dim.size
+    tps = params.token_patch_dim.size
+    token_x = rng.integers(0, params.vocab_size,
+                           (params.train_batch_size, seq, tps)
+                           ).astype(np.int32)
+    batch = {"token_x": jnp.asarray(token_x),
+             "token_y": jnp.asarray(token_x)}
+    variables = {k: jnp.asarray(v) for k, v in model.init(batch).items()}
+    return params, model, variables, token_x, batch
+
+
+# ---- entry-point lowerings -------------------------------------------------
+
+def make_trainer(params, model, batch):
+    """One ``(trainer, state)`` shared by every train-side lowering —
+    ``init_state`` materialises params + optimizer state, so ``audit_all``
+    pays it once instead of per entry point."""
+    from ..train import Trainer
+
+    trainer = Trainer(params, model)
+    return trainer, trainer.init_state(batch)
+
+
+def lower_train_step(params, model, variables, batch, donate: bool = True,
+                     trainer=None, state=None):
+    """Compiled donated train step.  ``donate=False`` compiles the same
+    step UNdonated — the negative control proving the donation audit bites
+    on real HLO, not only on synthetic text."""
+    import jax
+
+    if trainer is None:
+        trainer, state = make_trainer(params, model, batch)
+    if donate:
+        lowered = trainer.lowered(state, batch)
+    else:
+        lowered = trainer._build_step(donate=False).lower(
+            state, batch, jax.random.PRNGKey(0))
+    hlo = lowered.compile().as_text()
+    leaves = jax.tree_util.tree_leaves(state)
+    context = {
+        "donated_leaves": len(leaves) if donate else 0,
+        # a full copy of any param/optimizer-state leaf is the train-side
+        # analogue of the full-cache decode copy (2x HBM on the biggest
+        # buffers in the program)
+        "protected": hlo_lint.shape_strings(
+            {str(i): leaf for i, leaf in enumerate(leaves)}, min_rank=2),
+        "donated_bytes": sum(leaf.size * leaf.dtype.itemsize
+                             for leaf in leaves),
+        "state": state,
+    }
+    return hlo, context
+
+
+def lower_eval_fn(params, model, variables, batch, trainer=None, state=None):
+    """Compiled forward-only eval fn (no donation expected — variables are
+    reused across eval batches; the audit pins collectives + host syncs +
+    bf16 discipline)."""
+    if trainer is None:
+        trainer, state = make_trainer(params, model, batch)
+    hlo = trainer.lowered_eval(state, batch).compile().as_text()
+    context = {
+        "donated_leaves": 0,
+        "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
+                                              dtypes={"bf16"}),
+    }
+    return hlo, context
+
+
+def lower_decode_step(model, variables, token_x, logits_filter: bool = False,
+                      mesh=None):
+    """Compiled donated decode chunk step (the PR 2 property: every cache
+    leaf aliased, no full-cache-shaped copy).
+
+    Uses the zero-cache layout from ``decode_cache_shapes`` (the layout the
+    stepped driver carries) and abstract avals throughout: ``lower()``
+    needs shapes/dtypes only, and materialising the caches would allocate
+    the multi-GB buffers this check exists to police — running it next to
+    a live serving deployment must not OOM the chip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..infer.sampler import decode_cache_shapes, make_kv_step
+
+    aval = jax.ShapeDtypeStruct
+    batch = token_x.shape[0]
+    shapes = decode_cache_shapes(model, variables, token_x)
+    caches = {k: aval(v.shape, v.dtype) for k, v in shapes.items()}
+    step = jax.jit(make_kv_step(model, mesh=mesh,
+                                logits_filter=logits_filter),
+                   donate_argnums=(6,))
+    scalar = aval((), jnp.int32)
+    fargs = _filter_args(batch, logits_filter)
+    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
+    carry = (scalar, aval(tuple(token_x.shape), token_x.dtype), caches, key)
+    if logits_filter:
+        carry = carry + (aval((batch, model.params.vocab_size),
+                              jnp.float32),)
+    lowered = step.lower(variables, aval((batch,), jnp.int32),
+                         aval((batch,), jnp.float32), scalar, scalar,
+                         fargs, carry)
+    hlo = lowered.compile().as_text()
+    # the donated carry has EXACTLY len(shapes) cache leaves + q + token_x
+    # + key (+ seen under the filter); requiring that many aliases means
+    # every leaf aliased — a count any cache leaf could miss only by
+    # another, nonexistent leaf standing in for it
+    context = {
+        "donated_leaves": len(shapes) + 3 + (1 if logits_filter else 0),
+        "protected": hlo_lint.shape_strings(shapes, key_filter="/kv"),
+        "cache_shapes": shapes,
+        "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
+                                              dtypes={"bf16"}),
+    }
+    return hlo, context
+
+
+def lower_prefill_entry(model, variables, token_x,
+                        logits_filter: bool = False, mesh=None,
+                        donate: bool = True):
+    """Compiled cache-initialising first chunk (``kv_step_init`` — the
+    entry the prefill/steady split hands the donated carry to).  Its carry
+    omits the caches (built in-trace, mesh-constrained by the first decode
+    step) but q/token_x/key (+ seen) are still donated and must alias.
+
+    ``donate=False`` compiles the same step UNdonated — the negative
+    control for this entry point's donation audit.  The returned context
+    keeps the donated-case expectation either way, so the control asserts
+    the audit FLAGS the undonated module against it."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..infer.sampler import decode_cache_shapes, make_kv_step
+
+    aval = jax.ShapeDtypeStruct
+    batch = token_x.shape[0]
+    shapes = decode_cache_shapes(model, variables, token_x)
+    step = jax.jit(make_kv_step(model, mesh=mesh,
+                                logits_filter=logits_filter,
+                                init_caches=True),
+                   donate_argnums=(6,) if donate else ())
+    scalar = aval((), jnp.int32)
+    fargs = _filter_args(batch, logits_filter)
+    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
+    carry = (scalar, aval(tuple(token_x.shape), token_x.dtype), key)
+    if logits_filter:
+        carry = carry + (aval((batch, model.params.vocab_size),
+                              jnp.float32),)
+    lowered = step.lower(variables, aval((batch,), jnp.int32),
+                         aval((batch,), jnp.float32), scalar, scalar,
+                         fargs, carry)
+    hlo = lowered.compile().as_text()
+    context = {
+        "donated_leaves": 3 + (1 if logits_filter else 0),
+        "protected": hlo_lint.shape_strings(shapes, key_filter="/kv"),
+        "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
+                                              dtypes={"bf16"}),
+    }
+    return hlo, context
+
+
+def _filter_args(batch: int, logits_filter: bool):
+    import jax
+    import jax.numpy as jnp
+    aval = jax.ShapeDtypeStruct
+    if not logits_filter:
+        return ()
+    return (aval((batch,), jnp.int32), aval((batch,), jnp.float32),
+            aval((batch,), jnp.float32))
+
+
+# ---- one-call audit --------------------------------------------------------
+
+def audit_all(overrides: typing.Optional[dict] = None,
+              budgets: typing.Optional[dict] = None
+              ) -> typing.List[hlo_lint.Finding]:
+    """Every HLO pass over every registered entry point.  Donation audit
+    covers all four (eval's expectation is zero — a donation appearing
+    there would be a bug of its own kind, but zero aliases is its honest
+    baseline); the dtype-promotion pass skips the train step, where the
+    optimizer's f32 slice dtype legitimately promotes param-shaped grads.
+    """
+    import jax.numpy as jnp
+
+    budgets = budgets if budgets is not None else hlo_lint.load_budgets()
+    per_entry = budgets.get("entry_points", {})
+    params, model, variables, token_x, batch = build_audit_model(overrides)
+    trainer, state = make_trainer(params, model, batch)
+    findings: typing.List[hlo_lint.Finding] = []
+
+    hlo, ctx = lower_train_step(params, model, variables, batch,
+                                trainer=trainer, state=state)
+    train_budget = per_entry.get("train_step", {})
+    findings += hlo_lint.audit(
+        "train_step", hlo,
+        expected_aliases=ctx["donated_leaves"],
+        protected_shapes=ctx["protected"],
+        max_copied_bytes=int(train_budget.get("copy_byte_fraction", 0.0)
+                             * ctx["donated_bytes"]),
+        budget=train_budget)
+
+    hlo, ctx = lower_decode_step(model, variables, jnp.asarray(token_x))
+    findings += hlo_lint.audit(
+        "decode_chunk_step", hlo,
+        expected_aliases=ctx["donated_leaves"],
+        protected_shapes=ctx["protected"],
+        bf16_param_shapes=ctx["bf16_params"],
+        budget=per_entry.get("decode_chunk_step", {}))
+
+    hlo, ctx = lower_prefill_entry(model, variables, jnp.asarray(token_x))
+    findings += hlo_lint.audit(
+        "prefill_entry_step", hlo,
+        expected_aliases=ctx["donated_leaves"],
+        protected_shapes=ctx["protected"],
+        bf16_param_shapes=ctx["bf16_params"],
+        budget=per_entry.get("prefill_entry_step", {}))
+
+    hlo, ctx = lower_eval_fn(params, model, variables, batch,
+                             trainer=trainer, state=state)
+    findings += hlo_lint.audit(
+        "eval_fn", hlo,
+        expected_aliases=ctx["donated_leaves"],
+        bf16_param_shapes=ctx["bf16_params"],
+        budget=per_entry.get("eval_fn", {}))
+
+    return findings

@@ -488,8 +488,16 @@ class ModelParameter:
         if not self.use_video and self.language_token_per_frame != self.sequence_length:
             self.language_token_per_frame = self.sequence_length
         if self.use_random_dataloader:
-            print('WARNING: Use random dataset seed')
+            # deliberately unseeded: this IS the entropy source for the
+            # auto-generated data_seed  # graft-lint: allow[unseeded-rng]
             self.data_seed = int(np.random.default_rng().integers(0, 1_000_000))
+            # the chosen seed is printed here AND lands in the run_config_*
+            # json + a metrics.jsonl note (run/train_loop.py) so the run is
+            # reproducible after the fact: rerun with this data_seed and
+            # use_random_dataloader=false
+            print(f'WARNING: use_random_dataloader: data_seed '
+                  f'auto-generated -> {self.data_seed} (set data_seed='
+                  f'{self.data_seed} to reproduce this data order)')
         if self.combine_assignments:
             # the reference flag merged mtf assign ops into one op ("needs
             # more memory but it's faster", dataclass.py:77); the jitted

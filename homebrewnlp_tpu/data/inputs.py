@@ -396,7 +396,9 @@ class TextDataset:
         p = self.params
         its = [iter(s) for s in self.streams]
         if p.use_random_dataloader:
-            shuffle_rng = np.random.default_rng()  # deliberately unseeded
+            # deliberately unseeded: use_random_dataloader asks for fresh
+            # shuffle entropy per run  # graft-lint: allow[unseeded-rng]
+            shuffle_rng = np.random.default_rng()
             its = [_shuffle_windows(it, p.shuffle_buffer, shuffle_rng)
                    for it in its]
         seq_patches = p.sequence_length // p.token_patch_size

@@ -2,59 +2,29 @@
 
 The big-cache decode fix (infer/sampler.py stepped loop, ISSUE 2) rests on
 XLA's buffer aliaser keeping every per-token KV-cache update in place.  That
-is a property of the COMPILED module, not the traced program — the round-2
-fused while_loop traced identically at 0.5 GB and 6.5 GB yet only aliased at
-the former (BASELINE.md round 5).  So the property is tested, not hoped:
-these helpers lower the donated chunk step, then assert on the HLO text that
+is a property of the COMPILED module, not the traced one, and regresses
+silently (BASELINE.md round 5) — so it is tested, not hoped.
 
-  * the module's ``input_output_alias`` table covers every donated cache
-    leaf (donation actually took — an unaliasable layout or a dropped
-    donate_argnums would silently reintroduce the copy), and
-  * no ``copy``/``copy-start`` instruction produces a full KV-cache-shaped
-    buffer (the aliaser inserts exactly such copies when it cannot prove
-    in-place safety — the pre-fix module copied every stacked cache twice
-    per token at the nested-loop boundary).
+This module is now a thin compatibility shim: the reusable machinery moved
+to the unified static-analysis layer (``analysis/hlo_lint.py`` for the
+passes, ``analysis/entry_points.py`` for the lowering — which also audits
+the train step, prefill entry, and eval fn; docs/STATIC_ANALYSIS.md).  The
+public API and its AssertionError contract are unchanged:
 
-Scalar loop-counter copies, row-sized scatter traffic, and block-sized
-(1/depth) slice/relayout buffers on the attention read path are expected
-and allowed; only exact full-cache-shaped copies are flagged.
+  * ``assert_decode_step_inplace`` — lower + compile the donated chunk step
+    and assert every cache leaf aliased and no full-cache-shaped copy;
+  * ``assert_no_full_cache_copy`` / ``input_output_alias_count`` /
+    ``cache_shape_strings`` / ``lower_decode_step`` — the pieces, for
+    callers that assert on their own modules.
 """
 from __future__ import annotations
 
-import re
 import typing
 
-import numpy as np
+from ..analysis import hlo_lint
 
-# instruction line: "%name = <shape> <op>(...)" — the op name directly
-# follows the result shape (post-layout HLO text).  Async pairs: a
-# ``copy-start`` result is a TUPLE shape (unmatchable here), but its
-# ``copy-done`` twin's result is the plain copied array shape, so matching
-# copy-done catches every async copy exactly once.
-_COPY_RE = re.compile(
-    r"=\s*([a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+(copy|copy-done)\(")
-
-
-def input_output_alias_count(hlo_text: str) -> int:
-    """Number of entries in the entry module's input_output_alias table."""
-    start = hlo_text.find("input_output_alias={")
-    if start < 0:
-        return 0
-    # brace-scan to the table's closing brace (entries nest one level:
-    # "{0}: (31, {}, may-alias)")
-    i = hlo_text.index("{", start)
-    depth, end = 0, i
-    for end in range(i, len(hlo_text)):
-        depth += (hlo_text[end] == "{") - (hlo_text[end] == "}")
-        if depth == 0:
-            break
-    return len(re.findall(r"(?:may|must)-alias", hlo_text[i:end + 1]))
-
-
-_HLO_DTYPE = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
-              "float64": "f64", "int8": "s8", "uint8": "u8", "int16": "s16",
-              "int32": "s32", "int64": "s64", "uint32": "u32",
-              "uint64": "u64", "bool": "pred"}
+#: re-exported: the alias-table counter lives with the passes now
+input_output_alias_count = hlo_lint.input_output_alias_count
 
 
 def cache_shape_strings(cache_shapes: dict,
@@ -64,15 +34,7 @@ def cache_shape_strings(cache_shapes: dict,
     recurrence caches (cumsum totals, conv windows — O(batch*features)) are
     excluded: their per-token refresh legitimately rewrites the whole
     buffer."""
-    out = set()
-    for name, v in cache_shapes.items():
-        if key_filter not in name:
-            continue
-        dt = _HLO_DTYPE.get(str(np.dtype(v.dtype)))
-        if dt is None:
-            continue
-        out.add(f"{dt}[{','.join(str(d) for d in v.shape)}]")
-    return out
+    return hlo_lint.shape_strings(cache_shapes, key_filter=key_filter)
 
 
 def assert_no_full_cache_copy(hlo_text: str, cache_shapes: dict,
@@ -82,67 +44,34 @@ def assert_no_full_cache_copy(hlo_text: str, cache_shapes: dict,
     result is exactly a full KV-cache buffer (the aliaser inserts such
     copies when it cannot keep the carry update in place — block-sized
     slice/relayout traffic on the read path is allowed and expected), or if
-    fewer than ``min_aliases`` input/output aliases were established."""
+    fewer than ``min_aliases`` input/output aliases were established.
+
+    Decode runs the big-copy pass strict (``max_copied_bytes=0``): ANY
+    full-cache copy of live state is the round-5 regression."""
     targets = cache_shape_strings(cache_shapes)
     assert targets, f"no KV cache leaves in {list(cache_shapes)[:5]}"
-    offenders = []
-    for line in hlo_text.splitlines():
-        m = _COPY_RE.search(line)
-        if m is None:
-            continue
-        shape = m.group(1).split("{")[0]
-        if shape in targets:
-            offenders.append(line.strip())
-    assert not offenders, (
-        f"compiled decode step copies {len(offenders)} full KV-cache "
-        "buffer(s); the cache carry is NOT aliased in place:\n"
-        + "\n".join(offenders[:8]))
+    findings = hlo_lint.big_copy_audit("decode_chunk_step", hlo_text,
+                                       targets, max_copied_bytes=0)
+    assert not findings, "\n".join(str(f) for f in findings)
     if min_aliases is not None:
-        got = input_output_alias_count(hlo_text)
-        assert got >= min_aliases, (
-            f"only {got} input_output_alias entries (expected >= "
-            f"{min_aliases}): the donated decode carry did not alias")
+        findings = hlo_lint.donation_audit("decode_chunk_step", hlo_text,
+                                           min_aliases)
+        assert not findings, "\n".join(str(f) for f in findings)
 
 
 def lower_decode_step(model, variables, token_x,
                       logits_filter: bool = False, mesh=None):
     """Lower + compile the donated chunk step at ``token_x``'s shapes and
-    return ``(hlo_text, cache_shapes)`` for assertion.
+    return ``(hlo_text, cache_shapes)`` for assertion.  Delegates to
+    ``analysis/entry_points.lower_decode_step`` (abstract avals throughout —
+    auditing next to a live serving deployment must not OOM the chip; the
+    CURRENT backend, so on TPU this is the exact serving executable)."""
+    from ..analysis import entry_points
 
-    Uses the zero-cache layout from ``decode_cache_shapes`` (the layout the
-    stepped driver carries) and compiles on the CURRENT backend — on TPU
-    this asserts the exact serving executable; under the CPU test rig it
-    pins the structural property (donation + aliasable carry) that the TPU
-    compile inherits.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    from .sampler import decode_cache_shapes, make_kv_step
-
-    # abstract avals throughout: ``lower()`` needs shapes/dtypes only, and
-    # materialising the caches would allocate the multi-GB buffers this
-    # check exists to police — running it next to a live serving deployment
-    # must not OOM the chip
-    aval = jax.ShapeDtypeStruct
-    batch = token_x.shape[0]
-    shapes = decode_cache_shapes(model, variables, token_x)
-    caches = {k: aval(v.shape, v.dtype) for k, v in shapes.items()}
-    step = jax.jit(make_kv_step(model, mesh=mesh,
-                                logits_filter=logits_filter),
-                   donate_argnums=(6,))
-    ipb = aval((batch,), jnp.int32)
-    tb = aval((batch,), jnp.float32)
-    scalar = aval((), jnp.int32)
-    fargs = ((aval((batch,), jnp.int32), aval((batch,), jnp.float32),
-              aval((batch,), jnp.float32)) if logits_filter else ())
-    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
-    carry = (scalar, aval(tuple(token_x.shape), token_x.dtype), caches, key)
-    if logits_filter:
-        vocab = model.params.vocab_size
-        carry = carry + (aval((batch, vocab), jnp.float32),)
-    lowered = step.lower(variables, ipb, tb, scalar, scalar, fargs, carry)
-    return lowered.compile().as_text(), shapes
+    hlo, ctx = entry_points.lower_decode_step(model, variables, token_x,
+                                              logits_filter=logits_filter,
+                                              mesh=mesh)
+    return hlo, ctx["cache_shapes"]
 
 
 def assert_decode_step_inplace(model, variables, token_x,
@@ -150,11 +79,10 @@ def assert_decode_step_inplace(model, variables, token_x,
                                ) -> None:
     """End-to-end check: the per-token decode step's compiled module keeps
     every cache update in place (no full-cache copy, caches all aliased)."""
-    hlo, shapes = lower_decode_step(model, variables, token_x,
-                                    logits_filter=logits_filter, mesh=mesh)
-    # the donated carry has EXACTLY len(shapes) cache leaves + q + token_x
-    # + key (+ seen under the filter); requiring that many aliases means
-    # every leaf aliased — a count any cache leaf could miss only by
-    # another, nonexistent leaf standing in for it
-    donated_leaves = len(shapes) + 3 + (1 if logits_filter else 0)
-    assert_no_full_cache_copy(hlo, shapes, min_aliases=donated_leaves)
+    from ..analysis import entry_points
+
+    hlo, ctx = entry_points.lower_decode_step(model, variables, token_x,
+                                              logits_filter=logits_filter,
+                                              mesh=mesh)
+    assert_no_full_cache_copy(hlo, ctx["cache_shapes"],
+                              min_aliases=ctx["donated_leaves"])

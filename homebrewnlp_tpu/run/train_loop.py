@@ -88,6 +88,7 @@ class _ShutdownFlag:
 
 def _dump_run_config(params: ModelParameter):
     fs.makedirs(params.model_path)
+    # epoch filename stamp, not a duration  # graft-lint: allow[wallclock]
     path = fs.join(params.model_path, f"run_config_{int(time.time())}.json")
     safe = {}
     for k, v in params.dict().items():
@@ -291,6 +292,11 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             eval_batches = make_eval_batches(params, mesh=mesh)
 
     logger = MetricLogger(params.model_path) if is_chief else None
+    if logger is not None and params.use_random_dataloader:
+        # the auto-generated data_seed (config.py) must outlive the console:
+        # a metrics.jsonl note makes the run reproducible after the fact
+        logger.note(data_seed=int(params.data_seed),
+                    data_seed_auto_generated=True)
     # ---- telemetry (docs/OBSERVABILITY.md): everything below is created
     # ONCE, outside the loop; when telemetry_enabled is false, `phases` is
     # None and the step loop makes exactly zero registry calls
@@ -336,7 +342,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     consumed = 0
     it_count = 0
     last_metrics: typing.Dict[str, float] = {}
-    t_start = time.time()
+    t_start = time.monotonic()
     # preemption-safe shutdown: TPU preemptions deliver SIGTERM; finish the
     # in-flight step, write the emergency checkpoint (finally path), exit
     # resumable.  Previous handlers are restored on the way out; outside the
@@ -574,7 +580,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         finally:
             for sig, handler in prev_handlers.items():
                 signal.signal(sig, handler)
-    wall = time.time() - t_start
+    wall = time.monotonic() - t_start
     if stopped:
         print(f"preempted at step {int(state.step)}: emergency checkpoint "
               f"written; exit {PREEMPTED_EXIT_CODE} resumes from here",

@@ -207,7 +207,7 @@ class Trainer:
         return (new_vars, new_opt, step + 1), metrics
 
     # -- the jitted step ---------------------------------------------------
-    def _build_step(self):
+    def _build_step(self, donate: bool = True):
         p = self.params
 
         def step_fn(state: TrainState, batch, rng):
@@ -247,7 +247,9 @@ class Trainer:
                     (state.variables, state.opt_state, state.step))
             return TrainState(variables, opt_state, step), metrics
 
-        return jax.jit(step_fn, donate_argnums=(0,))
+        # ``donate=False`` compiles the identical step without donation —
+        # the HLO donation audit's negative control (analysis/entry_points)
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
     def lowered(self, state: TrainState, batch: typing.Dict[str, jax.Array]):
         """Lowered (StableHLO) train step for ``save_graph`` dumps — the
@@ -286,20 +288,36 @@ class Trainer:
         is its loss half.  Compiled once; the eval batch must be shaped like
         a train micro batch (no macro axis)."""
         p = self.params
-        if self._eval_fn is None:
-            def eval_fn(variables, batch):
-                saved = p.train
-                p.train = False  # trace-time flag: dropout/aux-inject off
-                try:
-                    info = self.model.apply(variables, batch, rng=None,
-                                            mesh=self.mesh)
-                finally:
-                    p.train = saved
-                return _info_metrics(info)
-            self._eval_fn = jax.jit(eval_fn)
+        self._ensure_eval_fn()
         if self.mesh is not None:
             batch = shardlib.shard_batch(p, batch, self.mesh, batch_axis=0)
         return self._eval_fn(state.variables, batch)
+
+    def _ensure_eval_fn(self):
+        if self._eval_fn is not None:
+            return
+        p = self.params
+
+        def eval_fn(variables, batch):
+            saved = p.train
+            p.train = False  # trace-time flag: dropout/aux-inject off
+            try:
+                info = self.model.apply(variables, batch, rng=None,
+                                        mesh=self.mesh)
+            finally:
+                p.train = saved
+            return _info_metrics(info)
+        self._eval_fn = jax.jit(eval_fn)
+
+    def lowered_eval(self, state: TrainState,
+                     batch: typing.Dict[str, jax.Array]):
+        """Lowered eval fn for the HLO audit (analysis/entry_points.py) —
+        the same jit ``eval_loss`` runs, without executing it."""
+        self._ensure_eval_fn()
+        if self.mesh is not None:
+            batch = shardlib.shard_batch(self.params, batch, self.mesh,
+                                         batch_axis=0)
+        return self._eval_fn.lower(state.variables, batch)
 
     def moe_stats(self, state: TrainState, batch: typing.Dict[str, jax.Array],
                   rng: typing.Optional[jax.Array] = None

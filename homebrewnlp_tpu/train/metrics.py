@@ -36,6 +36,7 @@ def encode_scalar_event(step: int, tag: str, value: float,
     summary_value = (_len_delim(1, tag.encode())      # Summary.Value.tag
                      + _float32_field(2, float(value)))  # simple_value
     summary = _len_delim(1, summary_value)
+    # tfevents wall_time is an epoch stamp  # graft-lint: allow[wallclock]
     event = (_float_field(1, wall_time if wall_time is not None else time.time())
              + _int_field(2, int(step))
              + _len_delim(5, summary))                # Event.summary
@@ -43,7 +44,7 @@ def encode_scalar_event(step: int, tag: str, value: float,
 
 
 def encode_file_version_event() -> bytes:
-    return (_float_field(1, time.time())
+    return (_float_field(1, time.time())  # graft-lint: allow[wallclock]
             + _len_delim(3, b"brain.Event:2"))
 
 
@@ -52,6 +53,7 @@ class SummaryWriter:
 
     def __init__(self, logdir: str):
         fs.makedirs(logdir)
+        # epoch filename stamp (TB convention)  # graft-lint: allow[wallclock]
         fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
         self._writer = RecordWriter(fs.join(logdir, fname))
         self._writer.write(encode_file_version_event())
@@ -114,6 +116,15 @@ class MetricLogger:
         stamp = time.strftime("%H:%M:%S")
         parts = " ".join(f"{k}={v:.5g}" for k, v in vals.items())
         print(f"\x1b[32;1m[{stamp}]\x1b[0m step={step} {parts}", flush=True)
+
+    def note(self, **fields):
+        """One JSONL line of run facts outside the step/metric stream
+        (e.g. the auto-generated data_seed) — no steps_per_sec arithmetic,
+        no TB scalars, flushed immediately so it survives a crash at step
+        0."""
+        entry = {"note": True, "wall": self._clock() - self._t0, **fields}
+        self.jsonl.write(json.dumps(entry) + "\n")
+        self.flush()
 
     def flush(self):
         self.jsonl.flush()

@@ -61,12 +61,12 @@ def _measure_stepped(model, variables, token_x, gen: int) -> dict:
     prep = _jit_sampler(model, None, "kv_prep")
     token_x, _ = prep(jnp.asarray(token_x), ipb)
     pf = _jit_sampler(model, None, "kv_prefill_caches")
-    t0 = time.time()
+    t0 = time.monotonic()
     caches = pf(variables, token_x, jnp.asarray(n0, jnp.int32))
     # sync by value materialisation (the tunnel's block_until_ready can
     # return early); one scalar read forces the dispatched chain
     np.asarray(jax.tree_util.tree_leaves(caches)[0].ravel()[:1])
-    ttft = time.time() - t0
+    ttft = time.monotonic() - t0
 
     step = _jit_sampler(model, None, "kv_step")
     chunk = max(1, int(model.params.decode_chunk_tokens))
@@ -82,14 +82,14 @@ def _measure_stepped(model, variables, token_x, gen: int) -> dict:
     carry = step(variables, ipb, tb, end, jnp.asarray(warm, jnp.int32),
                  (), carry)
     q = int(carry[0])
-    t0 = time.time()
+    t0 = time.monotonic()
     while q < seq - 1:
         q_hi = min(q + chunk, seq - 1)
         carry = step(variables, ipb, tb, end,
                      jnp.asarray(q_hi, jnp.int32), (), carry)
         q = q_hi
     np.asarray(carry[0])  # value sync
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
     timed = (seq - 1) - warm
     if timed < 1:
         raise ValueError(f"gen={gen} leaves no timed decode steps")
@@ -243,14 +243,14 @@ def main():
                     a = (variables, token_x, jnp.int32(prompt),
                          jnp.float32(0.0), jnp.int32(seq),
                          jax.random.PRNGKey(0), None)
-                    t_compile = time.time()
+                    t_compile = time.monotonic()
                     np.asarray(fn(*a))
-                    compile_s = time.time() - t_compile
+                    compile_s = time.monotonic() - t_compile
                     times = []
                     for _ in range(args.repeats):
-                        t0 = time.time()
+                        t0 = time.monotonic()
                         np.asarray(fn(*a))
-                        times.append(time.time() - t0)
+                        times.append(time.monotonic() - t0)
                     print(json.dumps({
                         "batch": batch, "seq": seq, "mode": kind,
                         "prompt": prompt, "compile_s": round(compile_s, 1),
@@ -263,18 +263,18 @@ def main():
             # caches=None: zeros built inside the trace — no host-side cache
             # allocation, no unusable-donation double buffer
             fn = jax.jit(make_kv_sampler(model))
-            t_compile = time.time()
+            t_compile = time.monotonic()
             out = fn(variables, token_x, jnp.int32(1), jnp.float32(0.8),
                      jnp.int32(seq), jax.random.PRNGKey(0), None)
             np.asarray(out)  # sync by value
-            compile_s = time.time() - t_compile
+            compile_s = time.monotonic() - t_compile
             times = []
             for r in range(args.repeats):
-                t0 = time.time()
+                t0 = time.monotonic()
                 out = fn(variables, token_x, jnp.int32(1), jnp.float32(0.8),
                          jnp.int32(seq), jax.random.PRNGKey(r), None)
                 np.asarray(out)
-                times.append(time.time() - t0)
+                times.append(time.monotonic() - t0)
             best = min(times)
             tokens = (seq - 1) * tps * batch
             print(json.dumps({

@@ -85,11 +85,11 @@ for _ in range(2):
     state, metrics = trainer.step(state, make_batch())
 float(metrics["loss"])
 batches = [make_batch() for _ in range(10)]
-t0 = time.time()
+t0 = time.monotonic()
 for b in batches:
     state, metrics = trainer.step(state, b)
 final = float(metrics["loss"])
-dt = time.time() - t0
+dt = time.monotonic() - t0
 tokens = 10 * params.train_batch_size * params.sequence_length
 print(json.dumps({"variant": %(name)r,
                   "tokens_per_sec_chip": round(tokens / dt, 1),

@@ -68,12 +68,12 @@ def measure(glob_pattern: str, batch: int, seq: int, interleave: int,
         it = iter(Prefetcher(iter(ds), depth=2) if prefetch else iter(ds))
         # warm: first batch pays file-open + (python path) full-file read
         next(it)
-        t0 = time.time()
+        t0 = time.monotonic()
         batches = 0
-        while time.time() - t0 < seconds:
+        while time.monotonic() - t0 < seconds:
             next(it)
             batches += 1
-        dt = time.time() - t0
+        dt = time.monotonic() - t0
         if prefetch:
             it.close()
         tokens = batches * batch * seq

@@ -60,21 +60,21 @@ def run(steps: int = 10) -> dict:
         return {"token_x": jnp.asarray(x),
                 "token_y": jnp.asarray((x + 1) % params.vocab_size)}
 
-    t0 = time.time()
+    t0 = time.monotonic()
     state = trainer.init_state(make_batch())
-    print(f"setup {time.time() - t0:.1f}s; compiling...", file=sys.stderr)
-    t0 = time.time()
+    print(f"setup {time.monotonic() - t0:.1f}s; compiling...", file=sys.stderr)
+    t0 = time.monotonic()
     for _ in range(WARMUP_STEPS):
         state, metrics = trainer.step(state, make_batch())
     float(metrics["loss"])  # force the dispatched chain to completion
-    print(f"compile+warmup {time.time() - t0:.1f}s", file=sys.stderr)
+    print(f"compile+warmup {time.monotonic() - t0:.1f}s", file=sys.stderr)
 
     batches = [make_batch() for _ in range(steps)]
-    t0 = time.time()
+    t0 = time.monotonic()
     for batch in batches:
         state, metrics = trainer.step(state, batch)
     final_loss = float(metrics["loss"])
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
 
     tokens = steps * params.train_batch_size * params.sequence_length
     n_chips = max(1, len(jax.devices()))

@@ -1,82 +1,37 @@
 #!/usr/bin/env python3
 """Lint: every ModelParameter config knob has a docs/CONFIG.md row.
 
-PRs 1-3 each hand-maintained this invariant when they added knobs; this
-makes it mechanical.  The knob set is read from ``config.py`` by AST — the
-``self.<name> = <default>`` assignments in ``ModelParameter.__init__``
-BEFORE the ``for k, v in config.items()`` update loop (everything after it
-is derived state, not configuration).  A knob counts as documented when it
-appears as a `` `name` `` table-row key anywhere in docs/CONFIG.md.
-
-Run standalone (exit 1 + a list on missing rows) or from the tier-1 test
-``tests/config_docs_test.py``.  No third-party imports and no jax — the
-config module is parsed, never executed.
+Now a thin shim: the rule moved into the unified static-analysis layer as
+``analysis/ast_lint.py``'s config-docs rule (run with every other rule by
+``scripts/graft_lint.py --ast``; docs/STATIC_ANALYSIS.md).  This entry
+point stays for muscle memory and for ``tests/config_docs_test.py``, and
+keeps the original contract: exit 1 + a list on missing rows, no
+third-party imports and no jax — the config module is parsed, never
+executed (``ast_lint`` is stdlib-only and loaded by file path, so this
+works without the package importable).
 """
 from __future__ import annotations
 
-import ast
+import importlib.util
 import os
-import re
 import sys
-import typing
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CONFIG_PY = os.path.join(REPO, "homebrewnlp_tpu", "config.py")
-CONFIG_MD = os.path.join(REPO, "docs", "CONFIG.md")
 
-#: internal bookkeeping assigned in the defaults section that is NOT a
-#: config knob (everything else there is)
-INTERNAL = {"unknown_config_keys"}
+_spec = importlib.util.spec_from_file_location(
+    "_graft_ast_lint", os.path.join(REPO, "homebrewnlp_tpu", "analysis",
+                                    "ast_lint.py"))
+_ast_lint = importlib.util.module_from_spec(_spec)
+# registered BEFORE exec: dataclasses resolves cls.__module__ there
+sys.modules[_spec.name] = _ast_lint
+_spec.loader.exec_module(_ast_lint)
 
-
-def config_knobs(source: str) -> typing.List[str]:
-    """``self.X = default`` names from ModelParameter.__init__, up to the
-    unknown-key update loop."""
-    tree = ast.parse(source)
-    init = None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "ModelParameter":
-            init = next(n for n in node.body
-                        if isinstance(n, ast.FunctionDef)
-                        and n.name == "__init__")
-            break
-    if init is None:
-        raise AssertionError("ModelParameter.__init__ not found")
-    knobs = []
-    for stmt in init.body:
-        if isinstance(stmt, ast.For):
-            # the `for k, v in config.items()` loop ends the defaults
-            # section; later assignments are validation/derivation
-            break
-        if isinstance(stmt, ast.Assign):
-            targets = stmt.targets
-        elif isinstance(stmt, ast.AnnAssign):
-            targets = [stmt.target]
-        else:
-            continue
-        for t in targets:
-            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
-                    and t.value.id == "self" and not t.attr.startswith("_")
-                    and t.attr not in INTERNAL):
-                knobs.append(t.attr)
-    if len(knobs) < 50:  # the reference schema alone has ~150
-        raise AssertionError(f"only {len(knobs)} knobs parsed — the "
-                             "defaults-section detection broke")
-    return knobs
-
-
-def documented_keys(md: str) -> typing.Set[str]:
-    """Keys of every ``| `name` | ...`` table row."""
-    return set(re.findall(r"^\|\s*`([A-Za-z_][A-Za-z_0-9]*)`", md, re.M))
-
-
-def missing_knobs(config_py: str = CONFIG_PY,
-                  config_md: str = CONFIG_MD) -> typing.List[str]:
-    with open(config_py) as f:
-        knobs = config_knobs(f.read())
-    with open(config_md) as f:
-        documented = documented_keys(f.read())
-    return sorted(set(k for k in knobs if k not in documented))
+CONFIG_PY = _ast_lint.CONFIG_PY
+CONFIG_MD = _ast_lint.CONFIG_MD
+INTERNAL = _ast_lint.INTERNAL
+config_knobs = _ast_lint.config_knobs
+documented_keys = _ast_lint.documented_keys
+missing_knobs = _ast_lint.missing_knobs
 
 
 def main() -> int:

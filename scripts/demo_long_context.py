@@ -67,11 +67,11 @@ def main():
 
     losses = []
     for i in range(args.steps):
-        t0 = time.time()
+        t0 = time.monotonic()
         state, metrics = trainer.step(state, batch, jax.random.PRNGKey(i))
         loss = float(metrics["loss"])
         losses.append(loss)
-        print(f"step {i}: loss={loss:.4f}  wall={time.time() - t0:.1f}s",
+        print(f"step {i}: loss={loss:.4f}  wall={time.monotonic() - t0:.1f}s",
               flush=True)
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses

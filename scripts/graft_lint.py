@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""graft-lint: run the repo's static-analysis layer from one entry point.
+
+Two halves (docs/STATIC_ANALYSIS.md):
+
+  --ast   AST rules over ``homebrewnlp_tpu/`` and ``scripts/`` (wall-clock
+          discipline, unseeded rngs, donated-jit registration, config-docs
+          coverage).  Stdlib-only, runs in well under a second.
+  --hlo   compiled-HLO audit of every registered jitted entry point (train
+          step, decode chunk step, prefill entry, eval fn): donation,
+          big-copy, dtype-promotion, collective census vs
+          ``analysis/budgets.json``, host-sync.  Compiles a small audit
+          model on the current backend (~15 s on one CPU).
+  --all   both (the pre-push / CI mode; also the default with no flags).
+
+Exit status is the number of findings clamped to 1 — nonzero means the
+repo violates an invariant.  The summary groups findings per rule so CI
+logs show at a glance WHICH invariant broke.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_ast() -> list:
+    from homebrewnlp_tpu.analysis import ast_lint
+    return ast_lint.lint_repo()
+
+
+def run_hlo(budgets_path=None) -> list:
+    from homebrewnlp_tpu.analysis import entry_points, hlo_lint
+    budgets = hlo_lint.load_budgets(budgets_path) if budgets_path else None
+    return entry_points.audit_all(budgets=budgets)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ast", action="store_true",
+                    help="AST rules only (fast, no jax)")
+    ap.add_argument("--hlo", action="store_true",
+                    help="compiled-HLO entry-point audit only")
+    ap.add_argument("--all", action="store_true",
+                    help="both halves (default when no flags given)")
+    ap.add_argument("--budgets", default=None,
+                    help="alternate budgets.json (default: "
+                         "analysis/budgets.json)")
+    args = ap.parse_args(argv)
+    do_ast = args.ast or args.all or not (args.ast or args.hlo)
+    do_hlo = args.hlo or args.all or not (args.ast or args.hlo)
+
+    findings = []
+    t0 = time.monotonic()
+    if do_ast:
+        findings += run_ast()
+    if do_hlo:
+        findings += run_hlo(args.budgets)
+    dt = time.monotonic() - t0
+
+    for f in findings:
+        print(f)
+    per_rule = collections.Counter(f.rule for f in findings)
+    halves = "+".join(h for h, on in (("ast", do_ast), ("hlo", do_hlo)) if on)
+    if findings:
+        summary = ", ".join(f"{rule}: {n}" for rule, n
+                            in sorted(per_rule.items()))
+        print(f"graft-lint [{halves}]: {len(findings)} finding(s) in "
+              f"{dt:.1f}s — {summary}", file=sys.stderr)
+        return 1
+    print(f"graft-lint [{halves}]: clean in {dt:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,7 +145,7 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
     from homebrewnlp_tpu.model import Model
     from homebrewnlp_tpu.train import Trainer, TrainState
 
-    t0 = time.time()
+    t0 = time.monotonic()
     td = topologies.get_topology_desc(platform="tpu", topology_name=topology)
     devices = td.devices
     if not os.path.isabs(config_path) and not os.path.exists(config_path):
@@ -212,11 +212,11 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
         rng_aval = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
 
         step_fn = trainer._build_step()
-        t_trace = time.time()
+        t_trace = time.monotonic()
         lowered = step_fn.lower(state_avals, batch_avals, rng_aval)
-        t_lower = time.time()
+        t_lower = time.monotonic()
         compiled = lowered.compile()
-        t_compile = time.time()
+        t_compile = time.monotonic()
 
         ma = compiled.memory_analysis()
         hlo = compiled.as_text()

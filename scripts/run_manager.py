@@ -96,6 +96,8 @@ class Manager:
             if self.args.model_path else None
         if not path or not os.path.exists(path):
             return 0.0
+        # compared against a file mtime, which is epoch wall time — a
+        # monotonic clock cannot age it  # graft-lint: allow[wallclock]
         return time.time() - os.path.getmtime(path)
 
     _spool_path = None

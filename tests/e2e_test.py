@@ -113,7 +113,7 @@ def debug_flags_e2e_test(tmp_path):
     r = _run_cli(config_path, "train")
     assert r.returncode == 0, r.stderr[-3000:]
     assert "debug_train_step: dispatched step" in r.stdout
-    assert "random dataset seed" in r.stdout
+    assert "data_seed auto-generated" in r.stdout
     assert "combine_assignments" in r.stdout
     hlo = (tmp_path / "run" / "train_step.stablehlo.txt").read_text()
     assert "stablehlo" in hlo or "mhlo" in hlo or "func.func" in hlo
