@@ -230,6 +230,33 @@ def main() -> int:
         except Exception as exc:
             print(f"collective census failed: {exc}", file=sys.stderr)
 
+    # per-scope cost ledger of the headline step (docs/OBSERVABILITY.md
+    # 'Cost attribution'): BENCH_*.json rows become self-attributing —
+    # which block holds the FLOPs/bytes, and what each is bound by.  A
+    # trace of the already-built step (no second compile); env gate
+    # mirrors BENCH_COLLECTIVES (BENCH_COST_LEDGER=1 forces on TPU, =0
+    # disables).
+    cost_ledger_tab = None
+    want_cl = os.environ.get("BENCH_COST_LEDGER", "auto")
+    if want_cl != "0" and (want_cl != "auto"
+                           or jax.default_backend() == "cpu"):
+        try:
+            from homebrewnlp_tpu.analysis import cost_ledger as cl
+            from homebrewnlp_tpu.utils import flops as flops_mod
+            traced = trainer._step_fn.trace(state, batches[0],
+                                            jax.random.PRNGKey(0))
+            # bench rows describe THIS device run: classify bounds against
+            # the measured chip's ridge, not the committed ledger's fixed
+            # reference chip (cost_ledger.ROOFLINE_DEVICE)
+            dev = jax.devices()[0]
+            cost_ledger_tab = cl.scope_table(
+                traced.jaxpr, peak=flops_mod.peak_flops(dev),
+                bandwidth=flops_mod.peak_hbm_bandwidth(dev))
+            cost_ledger_tab["roofline_device"] = str(
+                getattr(dev, "device_kind", jax.default_backend()))
+        except Exception as exc:
+            print(f"cost ledger failed: {exc}", file=sys.stderr)
+
     # first recorded value per backend becomes the baseline; later runs
     # report progress against it (batch size is part of the config identity
     # so an OOM-halved run never corrupts the full-batch baseline)
@@ -274,6 +301,8 @@ def main() -> int:
         out["telemetry"] = telemetry_summary
     if collectives is not None:
         out["collectives"] = collectives
+    if cost_ledger_tab is not None:
+        out["cost_ledger"] = cost_ledger_tab
     # the headline line goes out NOW: the companion's 16k compile can kill
     # the PROCESS (worker crash / OOM), which no except clause survives — a
     # consumer taking the last JSON line sees the enriched line when the

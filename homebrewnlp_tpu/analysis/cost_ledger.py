@@ -1,0 +1,475 @@
+"""Per-entry, per-scope cost ledger (docs/OBSERVABILITY.md 'Cost
+attribution').
+
+The model graph carries ``jax.named_scope`` regions (core/scope.py mirrors
+every scope frame into jax's name stack), so both jaxpr equations
+(``source_info.name_stack``) and compiled-HLO instructions
+(``metadata={op_name=...}``) name the block/layer that produced them.  This
+module turns that into a budgeted artifact:
+
+* :func:`build_ledger` — for each entry point in
+  ``analysis/entry_points.py``, walk the traced jaxpr with
+  ``utils.flops.scope_costs`` (matmul FLOPs + unfused bytes per name
+  stack), fold stacks into coarse :func:`scope_key` scopes, attach XLA's
+  whole-module ``cost_analysis`` numbers, and classify each scope against
+  the ``ROOFLINE_DEVICE`` roofline (compute- vs HBM-bound).
+* ``analysis/cost_ledger.json`` — the committed ledger;
+  :func:`ledger_audit` regression-checks a fresh build against it the way
+  ``budgets.json`` gates collectives (drift beyond ``tolerance`` = lint
+  finding; update protocol: ``python -m homebrewnlp_tpu.analysis.cost_ledger
+  --write`` and review the diff, docs/STATIC_ANALYSIS.md).
+* :func:`scope_map_from_hlo` — {instruction name -> op_name} from compiled
+  HLO text, the join key ``scripts/attribute_step.py`` uses to attribute
+  profiler trace time to the same scopes.
+
+Import stays cheap: jax only inside functions (the AST-only consumers of
+the package import this module's :func:`scope_key` without jax).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import typing
+
+from . import hlo_lint
+
+LEDGER_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "cost_ledger.json")
+
+#: the device kind whose roofline classifies scope bounds in the COMMITTED
+#: ledger — a fixed reference chip, so the bound column is deterministic
+#: across the CPU test rig and TPU runs (utils/flops.py tables; the v5e is
+#: the chip the flagship numbers were measured on)
+ROOFLINE_DEVICE = "TPU v5e"
+
+#: relative drift in per-scope flops/bytes the regression check tolerates
+DEFAULT_TOLERANCE = 0.05
+
+# ---- scope folding ---------------------------------------------------------
+
+_TRANSFORM_RE = re.compile(r"^[A-Za-z_][A-Za-z_0-9]*\((.*)\)$")
+_PHASES = ("input", "body", "output", "loss")
+#: named-scope markers that name a region directly (model/decode.py,
+#: infer/sampler.py, train/__init__.py)
+_SPECIAL = {"cache_read": "decode/cache_read",
+            "cache_write": "decode/cache_write",
+            "sampling": "decode/sampling",
+            "optimizer": "optimizer"}
+#: model/frontend.py LAYER_FUNCTIONS keys (mirrored, not imported — this
+#: module must stay importable without jax); update together
+_LAYER_NAMES = frozenset((
+    "feed_forward", "attention", "cummean", "cumsum", "norm", "rezero",
+    "activation", "convolution", "dropout", "group_linear", "split_path",
+    "feed_forward_product_key_memory", "product_key_memory",
+    "reduced_half_linear", "transpose_sequence_features",
+    "bottleneck_group_linear", "sum_heads"))
+
+
+def _unwrap(comp: str) -> str:
+    """``"transpose(jvp(gpt0))"`` -> ``"gpt0"``; plain names pass through."""
+    while True:
+        m = _TRANSFORM_RE.match(comp)
+        if m is None:
+            return comp
+        comp = m.group(1)
+
+
+def _basename(comp: str) -> str:
+    """Strip the scope-counter suffix: ``"attention_1"`` -> ``"attention"``,
+    ``"body0"`` -> ``"body"``."""
+    return comp.rstrip("0123456789").rstrip("_")
+
+
+def scope_key(path: str) -> str:
+    """Fold a name-stack / HLO ``op_name`` path into a coarse model scope.
+
+    Keys: ``decode/cache_read|cache_write|sampling``, ``optimizer``,
+    ``input/embed``, ``input``, ``body/<layer>``, ``output/unembed``,
+    ``output``, ``loss``, ``unscoped``.  Transform decorations
+    (``jvp``/``transpose``/``jit`` wrappers) are unwrapped, so forward and
+    backward ops of one block fold into the same scope — per-block
+    attribution, not per-pass."""
+    phase = None
+    layer = None
+    bases = []
+    for comp in str(path).split("/"):
+        base = _basename(_unwrap(comp))
+        bases.append(base)
+        if base in _SPECIAL:
+            return _SPECIAL[base]
+        if phase is None and base in _PHASES:
+            phase = base
+        elif phase is not None and layer is None and base in _LAYER_NAMES:
+            layer = base
+    if phase == "body" and layer is not None:
+        return f"body/{layer}"
+    if phase == "input":
+        return "input/embed" if ("embed" in bases or "gather" in bases) \
+            else "input"
+    if phase == "output":
+        return "output/unembed" if "embed" in bases else "output"
+    if phase is not None:
+        return phase
+    return "unscoped"
+
+
+# ---- ledger build ----------------------------------------------------------
+
+def _fold_scopes(raw: typing.Mapping[str, typing.Tuple[int, int]]
+                 ) -> typing.Dict[str, typing.Dict[str, int]]:
+    scopes: typing.Dict[str, typing.Dict[str, int]] = {}
+    for stack, (fl, by) in raw.items():
+        s = scopes.setdefault(scope_key(stack), {"flops": 0, "bytes": 0})
+        s["flops"] += int(fl)
+        s["bytes"] += int(by)
+    return scopes
+
+
+def _roofline():
+    from ..utils import flops as flops_mod
+    return (flops_mod.PEAK_TFLOPS[ROOFLINE_DEVICE],
+            flops_mod.HBM_BANDWIDTH[ROOFLINE_DEVICE])
+
+
+def scope_table(jaxpr, peak: typing.Optional[float] = None,
+                bandwidth: typing.Optional[float] = None
+                ) -> typing.Dict[str, typing.Any]:
+    """``{"total": {...}, "scopes": {scope: {flops, bytes, flops_share,
+    bytes_share, intensity, bound}}}`` for ONE traced jaxpr — the shared
+    core of the per-entry ledger, also consumed directly by ``bench.py``
+    (the ``"cost_ledger"`` result key).
+
+    ``peak``/``bandwidth`` override the :data:`ROOFLINE_DEVICE` ridge.
+    The committed ledger always classifies against the fixed reference
+    chip (determinism across rigs); callers describing a CONCRETE device
+    run — bench rows — pass the measured device's roofline instead, so a
+    scope isn't labelled hbm-bound by a ridge the benchmarked chip doesn't
+    have."""
+    from ..utils import flops as flops_mod
+    scopes = _fold_scopes(flops_mod.scope_costs(jaxpr))
+    tot_f = sum(s["flops"] for s in scopes.values())
+    tot_b = sum(s["bytes"] for s in scopes.values())
+    ref_peak, ref_bw = _roofline()
+    peak = ref_peak if peak is None else peak
+    bw = ref_bw if bandwidth is None else bandwidth
+    for s in scopes.values():
+        s["flops_share"] = round(s["flops"] / tot_f, 6) if tot_f else 0.0
+        s["bytes_share"] = round(s["bytes"] / tot_b, 6) if tot_b else 0.0
+        s["intensity"] = round(s["flops"] / s["bytes"], 4) if s["bytes"] \
+            else 0.0
+        s["bound"] = flops_mod.roofline_bound(s["flops"], s["bytes"],
+                                              peak, bw)
+    return {"total": {"flops": tot_f, "bytes": tot_b,
+                      "intensity": round(tot_f / tot_b, 4) if tot_b else 0.0,
+                      "bound": flops_mod.roofline_bound(tot_f, tot_b,
+                                                        peak, bw)},
+            "scopes": scopes}
+
+
+def _xla_costs(compiled) -> typing.Optional[dict]:
+    """Whole-module flops / bytes-accessed from XLA's own cost model —
+    recorded for cross-checking the analytical counts, NOT regression-
+    checked (backend- and version-dependent)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    if ca.get("flops") is not None:
+        out["flops"] = float(ca["flops"])
+    if ca.get("bytes accessed") is not None:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out or None
+
+
+def build_ledger(lowered: typing.Optional[dict] = None,
+                 overrides: typing.Optional[dict] = None) -> dict:
+    """The full ledger dict (the ``cost_ledger.json`` schema) from lowered
+    entry points (``entry_points.lower_all``; compiled fresh when None)."""
+    from . import entry_points
+    if lowered is None:
+        lowered = entry_points.lower_all(overrides)
+    entries = {}
+    for entry in entry_points.ENTRY_POINTS:
+        _, ctx = lowered[entry]
+        table = scope_table(ctx["trace"]())
+        xla = _xla_costs(ctx["compiled"])
+        if xla is not None:
+            table["xla_cost_analysis"] = xla
+        entries[entry] = table
+    return {
+        "_comment": [
+            "Per-entry, per-scope cost ledger at the AUDIT_CONFIG scale",
+            "(analysis/entry_points.py).  flops: exact matmul FLOPs from",
+            "the traced jaxpr (scans x trip count, full-square convention);",
+            "bytes: unfused operand+result traffic (uniform upper bound);",
+            "bound: compute- vs hbm- against the roofline_device ridge",
+            "point.  graft_lint --hlo regression-checks flops/bytes per",
+            "scope against a fresh build within `tolerance` — drift means",
+            "the model graph's cost structure changed; if intentional, run",
+            "`python -m homebrewnlp_tpu.analysis.cost_ledger --write` and",
+            "explain the shift in the PR (docs/STATIC_ANALYSIS.md).",
+            "xla_cost_analysis is informational (backend-dependent), never",
+            "regression-checked."],
+        "roofline_device": ROOFLINE_DEVICE,
+        "tolerance": DEFAULT_TOLERANCE,
+        "entry_points": entries,
+    }
+
+
+# ---- persistence + regression audit ---------------------------------------
+
+def load_ledger(path: typing.Optional[str] = None) -> typing.Optional[dict]:
+    p = path or LEDGER_PATH
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def write_ledger(ledger: typing.Optional[dict] = None,
+                 path: typing.Optional[str] = None) -> str:
+    p = path or LEDGER_PATH
+    ledger = ledger if ledger is not None else build_ledger()
+    with open(p, "w") as f:
+        json.dump(ledger, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+_UPDATE_HINT = ("if the cost structure changed intentionally, run `python "
+                "-m homebrewnlp_tpu.analysis.cost_ledger --write` and "
+                "explain the shift in the PR (docs/STATIC_ANALYSIS.md)")
+
+
+def ledger_audit(lowered: typing.Optional[dict] = None,
+                 path: typing.Optional[str] = None,
+                 current: typing.Optional[dict] = None
+                 ) -> typing.List[hlo_lint.Finding]:
+    """Regression-check a fresh ledger build against the committed one.
+
+    Tolerance is RELATIVE per scope per metric; a scope appearing or
+    vanishing is always a finding (a new model region must be ledgered, a
+    vanished one usually means attribution broke).  Zero-total entries are
+    compared structurally only."""
+    stored = load_ledger(path)
+    if stored is None:
+        return [hlo_lint.Finding(
+            "cost-ledger", "analysis/cost_ledger.json",
+            "ledger file missing — every entry point must carry a committed "
+            "cost ledger; " + _UPDATE_HINT)]
+    if current is None:
+        current = build_ledger(lowered)
+    tol = float(stored.get("tolerance", DEFAULT_TOLERANCE))
+    findings: typing.List[hlo_lint.Finding] = []
+    stored_entries = stored.get("entry_points", {})
+    for gone in sorted(set(stored_entries) - set(current["entry_points"])):
+        findings.append(hlo_lint.Finding(
+            "cost-ledger", gone,
+            "entry point vanished from the fresh build but is still in the "
+            "committed ledger; " + _UPDATE_HINT))
+    for entry, cur in current["entry_points"].items():
+        if entry not in stored_entries:
+            findings.append(hlo_lint.Finding(
+                "cost-ledger", entry,
+                "entry point missing from the committed ledger; "
+                + _UPDATE_HINT))
+            continue
+        old = stored_entries[entry]
+        old_scopes = old.get("scopes", {})
+        cur_scopes = cur["scopes"]
+        for gone in sorted(set(old_scopes) - set(cur_scopes)):
+            findings.append(hlo_lint.Finding(
+                "cost-ledger", entry,
+                f"scope {gone!r} vanished from the ledger (attribution "
+                "broke, or the region was removed); " + _UPDATE_HINT))
+        for new in sorted(set(cur_scopes) - set(old_scopes)):
+            findings.append(hlo_lint.Finding(
+                "cost-ledger", entry,
+                f"scope {new!r} is not in the committed ledger; "
+                + _UPDATE_HINT))
+        for scope in sorted(set(cur_scopes) & set(old_scopes)):
+            for metric in ("flops", "bytes"):
+                a = float(old_scopes[scope].get(metric, 0))
+                b = float(cur_scopes[scope].get(metric, 0))
+                base = max(abs(a), 1.0)
+                if abs(b - a) / base > tol:
+                    findings.append(hlo_lint.Finding(
+                        "cost-ledger", entry,
+                        f"scope {scope!r} {metric} drifted "
+                        f"{a:.3g} -> {b:.3g} (> {tol:.0%} tolerance); "
+                        + _UPDATE_HINT))
+    return findings
+
+
+# ---- HLO instruction -> scope join (scripts/attribute_step.py) -------------
+
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([A-Za-z0-9_.$-]+)\s*=\s*"
+    r"(?:\([^)]*\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s+([a-zA-Z][\w-]*)\(")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([A-Za-z0-9_.$-]+)\s+\(")
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+_CALLS_RE = re.compile(r"(?:calls|to_apply|body)=%?([A-Za-z0-9_.$-]+)")
+
+#: instruction kinds whose profiler event WRAPS its children's events
+#: (the body ops report separately) — excluded from attribution totals or
+#: every while/call body would double-count
+CONTAINER_KINDS = frozenset(("while", "call", "conditional"))
+
+
+def instruction_table(hlo_text: str
+                      ) -> typing.Dict[str, typing.Dict[str, typing.Any]]:
+    """``{instruction_name: {"kind", "op_name", "calls"}}`` over every
+    instruction of one compiled module's text, computation bodies included.
+
+    Fusion/call instructions often carry no ``op_name`` of their own; their
+    scope is inherited from the called computation's ROOT instruction (one
+    ``calls=`` hop at lookup time, :func:`attribute_events`)."""
+    table: typing.Dict[str, typing.Dict[str, typing.Any]] = {}
+    comp_root_op: typing.Dict[str, typing.Optional[str]] = {}
+    comp_root_instr: typing.Dict[str, str] = {}
+    comp_votes: typing.Dict[str, typing.Dict[str, int]] = {}
+    current_comp = None
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            if line and not line[0].isspace():
+                c = _COMP_RE.match(line)
+                if c is not None:
+                    current_comp = c.group(1)
+            continue
+        name, kind = m.group(1), m.group(2)
+        op = _OP_NAME_RE.search(line)
+        op_name = op.group(1) if op else None
+        calls = _CALLS_RE.search(line)
+        table[name] = {"kind": kind, "op_name": op_name,
+                       "calls": calls.group(1) if calls else None}
+        if current_comp is not None:
+            if op_name is not None:
+                votes = comp_votes.setdefault(current_comp, {})
+                votes[op_name] = votes.get(op_name, 0) + 1
+            if line.lstrip().startswith("ROOT "):
+                comp_root_instr[current_comp] = name
+                if op_name is not None:
+                    comp_root_op[current_comp] = op_name
+    # a computation's scope: its ROOT's op_name when present, else the
+    # majority op_name among its member instructions (fusion roots are
+    # often metadata-less bitcasts/copies while the fused math carries the
+    # scope)
+    comp_op: typing.Dict[str, str] = {}
+    for comp, votes in comp_votes.items():
+        root = comp_root_op.get(comp)
+        comp_op[comp] = root if root is not None else \
+            max(votes.items(), key=lambda kv: kv[1])[0]
+    # resolve missing op_names through the calls -> computation chain
+    # (bounded hops: e.g. call -> computation whose root is a fusion)
+    for name, info in table.items():
+        comp = info["calls"]
+        hops = 0
+        while info["op_name"] is None and comp and hops < 4:
+            inherited = comp_op.get(comp)
+            if inherited is not None:
+                info["op_name"] = inherited
+                break
+            # the called computation carries no metadata anywhere: delegate
+            # to whatever ITS root instruction calls (call->fusion chains)
+            root = table.get(comp_root_instr.get(comp, ""))
+            comp = root["calls"] if root else None
+            hops += 1
+    return table
+
+
+def scope_map_from_hlo(hlo_text: str) -> typing.Dict[str, str]:
+    """``{instruction_name: op_name}`` (inheritance applied) — profiler
+    trace events carry the instruction name (``args.hlo_op``), metadata
+    carries the named-scope path; this map is the join between them."""
+    return {name: info["op_name"]
+            for name, info in instruction_table(hlo_text).items()
+            if info["op_name"] is not None}
+
+
+def _lookup_instr(table: typing.Mapping[str, dict], hlo_op: str
+                  ) -> typing.Optional[dict]:
+    """The trace's ``hlo_op`` vs the HLO text name can differ by a
+    ``.clone`` suffix in either direction (CPU thunks clone parallelized
+    fusion roots) — try all three spellings."""
+    for cand in (hlo_op, hlo_op + ".clone",
+                 hlo_op[:-len(".clone")] if hlo_op.endswith(".clone")
+                 else hlo_op):
+        info = table.get(cand)
+        if info is not None:
+            return info
+    return None
+
+
+def attribute_events(events: typing.Iterable[typing.Tuple[str, float]],
+                     table: typing.Mapping[str, dict]
+                     ) -> typing.Tuple[typing.Dict[str, float],
+                                       typing.Dict[str, float], float]:
+    """Attribute ``(hlo_op, duration)`` device events to model scopes.
+
+    Returns ``(per_scope_duration, unattributed_by_op, total_duration)``.
+    Container instructions (while/call/conditional — their events wrap the
+    body ops' own events) are excluded from the total entirely; everything
+    else either folds into its :func:`scope_key` or lands in
+    ``unattributed`` (which the caller should report loudly — a growing
+    unattributed share means the scope annotations or this join broke)."""
+    per_scope: typing.Dict[str, float] = {}
+    unattr: typing.Dict[str, float] = {}
+    total = 0.0
+    for hlo_op, dur in events:
+        info = _lookup_instr(table, hlo_op)
+        if info is not None and info["kind"] in CONTAINER_KINDS:
+            continue
+        base = hlo_op.split(".")[0]
+        if info is None and base in CONTAINER_KINDS:
+            continue
+        total += dur
+        if info is None or info["op_name"] is None:
+            unattr[hlo_op] = unattr.get(hlo_op, 0.0) + dur
+            per_scope["unattributed"] = per_scope.get("unattributed",
+                                                      0.0) + dur
+            continue
+        key = scope_key(info["op_name"])
+        per_scope[key] = per_scope.get(key, 0.0) + dur
+    return per_scope, unattr, total
+
+
+# ---- CLI -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="build / check the per-scope cost ledger")
+    ap.add_argument("--write", action="store_true",
+                    help="rebuild analysis/cost_ledger.json from the "
+                         "current model (the budget-update protocol)")
+    ap.add_argument("--check", action="store_true",
+                    help="regression-check against the committed ledger "
+                         "(default)")
+    ap.add_argument("--path", default=None,
+                    help="alternate ledger path (default: "
+                         "analysis/cost_ledger.json)")
+    args = ap.parse_args(argv)
+    if args.write:
+        p = write_ledger(path=args.path)
+        print(f"cost ledger written to {p}")
+        return 0
+    findings = ledger_audit(path=args.path)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"cost-ledger: {len(findings)} finding(s)")
+        return 1
+    print("cost-ledger: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -107,7 +107,8 @@ def lower_train_step(params, model, variables, batch, donate: bool = True,
     else:
         lowered = trainer._build_step(donate=False).lower(
             state, batch, jax.random.PRNGKey(0))
-    hlo = lowered.compile().as_text()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
     leaves = jax.tree_util.tree_leaves(state)
     context = {
         "donated_leaves": len(leaves) if donate else 0,
@@ -119,6 +120,12 @@ def lower_train_step(params, model, variables, batch, donate: bool = True,
         "donated_bytes": sum(leaf.size * leaf.dtype.itemsize
                              for leaf in leaves),
         "state": state,
+        "compiled": compiled,
+        # jaxpr thunk for the cost ledger's analytical per-scope counts —
+        # tracing is cheap next to the compile above, and only the ledger
+        # pays it.  Undonated: donation changes aliasing, never flops.
+        "trace": lambda: trainer._build_step(donate=False).trace(
+            state, batch, jax.random.PRNGKey(0)).jaxpr,
     }
     return hlo, context
 
@@ -129,11 +136,15 @@ def lower_eval_fn(params, model, variables, batch, trainer=None, state=None):
     bf16 discipline)."""
     if trainer is None:
         trainer, state = make_trainer(params, model, batch)
-    hlo = trainer.lowered_eval(state, batch).compile().as_text()
+    compiled = trainer.lowered_eval(state, batch).compile()
+    hlo = compiled.as_text()
     context = {
         "donated_leaves": 0,
         "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
                                               dtypes={"bf16"}),
+        "compiled": compiled,
+        "trace": lambda: trainer._eval_fn.trace(state.variables,
+                                                batch).jaxpr,
     }
     return hlo, context
 
@@ -168,10 +179,10 @@ def lower_decode_step(model, variables, token_x, logits_filter: bool = False,
     if logits_filter:
         carry = carry + (aval((batch, model.params.vocab_size),
                               jnp.float32),)
-    lowered = step.lower(variables, aval((batch,), jnp.int32),
-                         aval((batch,), jnp.float32), scalar, scalar,
-                         fargs, carry)
-    hlo = lowered.compile().as_text()
+    args = (variables, aval((batch,), jnp.int32),
+            aval((batch,), jnp.float32), scalar, scalar, fargs, carry)
+    compiled = step.lower(*args).compile()
+    hlo = compiled.as_text()
     # the donated carry has EXACTLY len(shapes) cache leaves + q + token_x
     # + key (+ seen under the filter); requiring that many aliases means
     # every leaf aliased — a count any cache leaf could miss only by
@@ -182,6 +193,8 @@ def lower_decode_step(model, variables, token_x, logits_filter: bool = False,
         "cache_shapes": shapes,
         "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
                                               dtypes={"bf16"}),
+        "compiled": compiled,
+        "trace": lambda: step.trace(*args).jaxpr,
     }
     return hlo, context
 
@@ -217,15 +230,17 @@ def lower_prefill_entry(model, variables, token_x,
     if logits_filter:
         carry = carry + (aval((batch, model.params.vocab_size),
                               jnp.float32),)
-    lowered = step.lower(variables, aval((batch,), jnp.int32),
-                         aval((batch,), jnp.float32), scalar, scalar,
-                         fargs, carry)
-    hlo = lowered.compile().as_text()
+    args = (variables, aval((batch,), jnp.int32),
+            aval((batch,), jnp.float32), scalar, scalar, fargs, carry)
+    compiled = step.lower(*args).compile()
+    hlo = compiled.as_text()
     context = {
         "donated_leaves": 3 + (1 if logits_filter else 0),
         "protected": hlo_lint.shape_strings(shapes, key_filter="/kv"),
         "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
                                               dtypes={"bf16"}),
+        "compiled": compiled,
+        "trace": lambda: step.trace(*args).jaxpr,
     }
     return hlo, context
 
@@ -242,25 +257,67 @@ def _filter_args(batch: int, logits_filter: bool):
 
 # ---- one-call audit --------------------------------------------------------
 
-def audit_all(overrides: typing.Optional[dict] = None,
-              budgets: typing.Optional[dict] = None
-              ) -> typing.List[hlo_lint.Finding]:
-    """Every HLO pass over every registered entry point.  Donation audit
-    covers all four (eval's expectation is zero — a donation appearing
-    there would be a bug of its own kind, but zero aliases is its honest
-    baseline); the dtype-promotion pass skips the train step, where the
-    optimizer's f32 slice dtype legitimately promotes param-shaped grads.
-    """
+def lower_all(overrides: typing.Optional[dict] = None
+              ) -> "typing.Dict[str, typing.Tuple[str, dict]]":
+    """``{entry: (hlo_text, context)}`` for every registered entry point,
+    from ONE shared audit model + trainer build.  Contexts carry the
+    ``compiled`` executable (for ``cost_analysis``) and a ``trace`` thunk
+    producing the entry's jaxpr — the cost ledger (analysis/cost_ledger.py)
+    and the HLO audits below consume the same compiles, so running both in
+    ``graft_lint --hlo`` pays the four compiles once."""
     import jax.numpy as jnp
 
-    budgets = budgets if budgets is not None else hlo_lint.load_budgets()
-    per_entry = budgets.get("entry_points", {})
     params, model, variables, token_x, batch = build_audit_model(overrides)
     trainer, state = make_trainer(params, model, batch)
+    out: typing.Dict[str, typing.Tuple[str, dict]] = {}
+    out["train_step"] = lower_train_step(params, model, variables, batch,
+                                         trainer=trainer, state=state)
+    out["decode_chunk_step"] = lower_decode_step(model, variables,
+                                                 jnp.asarray(token_x))
+    out["prefill_entry_step"] = lower_prefill_entry(model, variables,
+                                                    jnp.asarray(token_x))
+    out["eval_fn"] = lower_eval_fn(params, model, variables, batch,
+                                   trainer=trainer, state=state)
+    return out
+
+
+def lower_one(entry: str, overrides: typing.Optional[dict] = None
+              ) -> typing.Tuple[str, dict]:
+    """``(hlo_text, context)`` for ONE entry point — what
+    ``scripts/attribute_step.py`` uses so a single-entry trace join pays
+    one compile, not four."""
+    import jax.numpy as jnp
+
+    if entry not in ENTRY_POINTS:
+        raise ValueError(f"unknown entry point {entry!r}; one of "
+                         f"{ENTRY_POINTS}")
+    params, model, variables, token_x, batch = build_audit_model(overrides)
+    if entry in ("train_step", "eval_fn"):
+        trainer, state = make_trainer(params, model, batch)
+        if entry == "train_step":
+            return lower_train_step(params, model, variables, batch,
+                                    trainer=trainer, state=state)
+        return lower_eval_fn(params, model, variables, batch,
+                             trainer=trainer, state=state)
+    if entry == "decode_chunk_step":
+        return lower_decode_step(model, variables, jnp.asarray(token_x))
+    return lower_prefill_entry(model, variables, jnp.asarray(token_x))
+
+
+def audit_lowered(lowered: "typing.Dict[str, typing.Tuple[str, dict]]",
+                  budgets: typing.Optional[dict] = None
+                  ) -> typing.List[hlo_lint.Finding]:
+    """Every HLO pass over pre-lowered entry points (``lower_all``).
+    Donation audit covers all four (eval's expectation is zero — a donation
+    appearing there would be a bug of its own kind, but zero aliases is its
+    honest baseline); the dtype-promotion pass skips the train step, where
+    the optimizer's f32 slice dtype legitimately promotes param-shaped
+    grads."""
+    budgets = budgets if budgets is not None else hlo_lint.load_budgets()
+    per_entry = budgets.get("entry_points", {})
     findings: typing.List[hlo_lint.Finding] = []
 
-    hlo, ctx = lower_train_step(params, model, variables, batch,
-                                trainer=trainer, state=state)
+    hlo, ctx = lowered["train_step"]
     train_budget = per_entry.get("train_step", {})
     findings += hlo_lint.audit(
         "train_step", hlo,
@@ -270,24 +327,16 @@ def audit_all(overrides: typing.Optional[dict] = None,
                              * ctx["donated_bytes"]),
         budget=train_budget)
 
-    hlo, ctx = lower_decode_step(model, variables, jnp.asarray(token_x))
-    findings += hlo_lint.audit(
-        "decode_chunk_step", hlo,
-        expected_aliases=ctx["donated_leaves"],
-        protected_shapes=ctx["protected"],
-        bf16_param_shapes=ctx["bf16_params"],
-        budget=per_entry.get("decode_chunk_step", {}))
+    for entry in ("decode_chunk_step", "prefill_entry_step"):
+        hlo, ctx = lowered[entry]
+        findings += hlo_lint.audit(
+            entry, hlo,
+            expected_aliases=ctx["donated_leaves"],
+            protected_shapes=ctx["protected"],
+            bf16_param_shapes=ctx["bf16_params"],
+            budget=per_entry.get(entry, {}))
 
-    hlo, ctx = lower_prefill_entry(model, variables, jnp.asarray(token_x))
-    findings += hlo_lint.audit(
-        "prefill_entry_step", hlo,
-        expected_aliases=ctx["donated_leaves"],
-        protected_shapes=ctx["protected"],
-        bf16_param_shapes=ctx["bf16_params"],
-        budget=per_entry.get("prefill_entry_step", {}))
-
-    hlo, ctx = lower_eval_fn(params, model, variables, batch,
-                             trainer=trainer, state=state)
+    hlo, ctx = lowered["eval_fn"]
     findings += hlo_lint.audit(
         "eval_fn", hlo,
         expected_aliases=ctx["donated_leaves"],
@@ -295,3 +344,11 @@ def audit_all(overrides: typing.Optional[dict] = None,
         budget=per_entry.get("eval_fn", {}))
 
     return findings
+
+
+def audit_all(overrides: typing.Optional[dict] = None,
+              budgets: typing.Optional[dict] = None
+              ) -> typing.List[hlo_lint.Finding]:
+    """``audit_lowered(lower_all(overrides))`` — the one-call form tier-1
+    and older callers use."""
+    return audit_lowered(lower_all(overrides), budgets)

@@ -132,9 +132,16 @@ def context(ctx: Context):
 @contextlib.contextmanager
 def name_scope(name: str):
     ctx = current()
-    ctx.enter(name)
+    scoped_name = ctx.enter(name)
     try:
-        yield
+        # mirror the scope frame into jax's name stack: every op traced
+        # inside lands in compiled-HLO ``metadata={op_name=...}`` and in
+        # jaxpr ``source_info.name_stack`` with its block/layer identity —
+        # the substrate the cost ledger (analysis/cost_ledger.py) and trace
+        # attribution (scripts/attribute_step.py) join on.  Metadata only:
+        # the compiled program is unchanged.
+        with jax.named_scope(scoped_name):
+            yield
     finally:
         ctx.exit()
 

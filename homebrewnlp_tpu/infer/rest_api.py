@@ -25,6 +25,7 @@ request receives exactly one JSON answer.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 import typing
@@ -98,8 +99,103 @@ def _serve_metrics() -> dict:
                 "hbnlp_serve_batch_size",
                 "completion requests sharing one decode round",
                 buckets=(1, 2, 4, 8, 16, 32, 64, 128)),
+            # latency anatomy (docs/OBSERVABILITY.md 'Cost attribution'):
+            # the monolithic decode histogram split into the two numbers
+            # serving SLOs are written against — time to FIRST token per
+            # request (admission -> first generated token, measured at the
+            # stepped loop's prefill/decode chunk boundary) and the
+            # inter-token latency per decode chunk.  Stepped decode loop
+            # only (the fused while_loop has no observable chunk boundary).
+            "ttft": r.histogram(
+                "hbnlp_serve_ttft_seconds",
+                "admission to first generated token, per request (stepped "
+                "decode loop)"),
+            "itl": r.histogram(
+                "hbnlp_serve_itl_seconds",
+                "seconds per token position within one decode chunk "
+                "(stepped decode loop; first chunk includes any prompt "
+                "walk)"),
+            "cache_bps": r.gauge(
+                "hbnlp_decode_cache_read_bytes_per_second",
+                "achieved KV-cache read bandwidth of the last decode chunk "
+                "(cache bytes x steps / chunk seconds)"),
+            "cache_bw_frac": r.gauge(
+                "hbnlp_decode_cache_bw_fraction_of_peak",
+                "last chunk's cache read bandwidth over the device's peak "
+                "HBM bandwidth — ~1.0 means decode sits ON the roofline "
+                "PR 2 proved governs it"),
         }
     return _SERVE_METRICS
+
+
+# peak HBM bandwidth of the serving device, read once (device loop only —
+# the HTTP child never decodes)
+_HBM_PEAK = None
+
+
+def _hbm_peak() -> float:
+    global _HBM_PEAK
+    if _HBM_PEAK is None:
+        try:
+            from ..utils.flops import peak_hbm_bandwidth
+            _HBM_PEAK = float(peak_hbm_bandwidth())
+        except Exception:
+            _HBM_PEAK = 0.0
+    return _HBM_PEAK
+
+
+@contextlib.contextmanager
+def _decode_progress(enqueues: typing.Sequence[typing.Optional[float]],
+                     closed: typing.Optional[typing.List[bool]] = None):
+    """Install the sampler decode-progress hook for one decode call: chunk
+    events feed the ITL histogram and the cache-bandwidth gauges; the
+    first-token event closes one TTFT observation per co-batched request
+    (``enqueues``: each request's admission timestamp — monotonic,
+    comparable cross-process; None entries fall back to install time, the
+    in-process path's admission proxy).
+
+    ``closed`` (row-aligned with ``enqueues``) carries each request's
+    TTFT-already-observed flag across decode ATTEMPTS: a failed batch whose
+    chunks already fired some rows' first tokens is retried per row, and
+    the retry must not observe a second TTFT sample for them.  None = a
+    fresh single-attempt decode."""
+    from . import sampler as sampler_mod
+    m = _serve_metrics()
+    t_install = time.monotonic()
+    starts = [t_install if ts is None else ts for ts in enqueues]
+    if closed is None:
+        closed = [False] * len(starts)
+
+    def hook(event: str, **kw):
+        now = time.monotonic()
+        if event == "first_token":
+            # rows: which co-batched requests' first token THIS event marks
+            # (per-row thresholds in the stepped loop — longer prompts fire
+            # later); absent = all of them, each closed at most once
+            rows = kw.get("rows")
+            targets = range(len(starts)) if rows is None else rows
+            for i in targets:
+                if 0 <= i < len(starts) and not closed[i]:
+                    closed[i] = True
+                    m["ttft"].observe(max(0.0, now - starts[i]))
+        elif event == "chunk":
+            steps = int(kw.get("steps") or 0)
+            dt = float(kw.get("dt") or 0.0)
+            if steps > 0 and dt > 0:
+                m["itl"].observe(dt / steps)
+                cb = int(kw.get("cache_bytes") or 0)
+                if cb:
+                    bps = cb * steps / dt
+                    m["cache_bps"].set(bps)
+                    peak = _hbm_peak()
+                    if peak:
+                        m["cache_bw_frac"].set(bps / peak)
+
+    prev = sampler_mod.set_decode_progress_hook(hook)
+    try:
+        yield
+    finally:
+        sampler_mod.set_decode_progress_hook(prev)
 
 
 def _record_decode(dt: float, generated_tokens: int):
@@ -183,15 +279,18 @@ def _format_completion(interface, path: str, prompt_toks, out,
     return r
 
 
-def _complete_one(interface, path: str, parsed) -> dict:
+def _complete_one(interface, path: str, parsed,
+                  enqueue_ts: typing.Optional[float] = None) -> dict:
     """Decode + format ONE parsed completion request — the single shared
     decode path for the handlers and the device loop's single-request
     branch (parsing already happened; any exception here is a decode
-    failure)."""
+    failure).  ``enqueue_ts``: admission timestamp for the TTFT
+    histogram (None in the in-process path — decode start stands in)."""
     toks, temp, rl, tk, tp, rp = parsed
     t0 = time.monotonic()
-    out = interface.complete_tokens(toks, temp, rl, top_k=tk, top_p=tp,
-                                    repetition_penalty=rp)
+    with _decode_progress([enqueue_ts]):
+        out = interface.complete_tokens(toks, temp, rl, top_k=tk, top_p=tp,
+                                        repetition_penalty=rp)
     kept_limit = _prompt_capacity(interface)
     _record_decode(time.monotonic() - t0,
                    max(0, len(out) - min(len(toks), kept_limit)))
@@ -202,7 +301,8 @@ def _complete_batch(interface: InterfaceWrapper,
                     items: typing.List[typing.Tuple[str, dict]],
                     deadlines: typing.Optional[typing.List[typing.Optional[float]]] = None,
                     guard: typing.Optional[ServingGuard] = None,
-                    clock: typing.Callable[[], float] = time.monotonic
+                    clock: typing.Callable[[], float] = time.monotonic,
+                    enqueues: typing.Optional[typing.List[typing.Optional[float]]] = None
                     ) -> typing.List[dict]:
     """N queued /completion + /token_completion requests -> ONE decode call
     (InterfaceWrapper.complete_tokens_batch).  Per-item parse errors answer
@@ -237,11 +337,18 @@ def _complete_batch(interface: InterfaceWrapper,
                                   kept_limit)
 
     if idx:
+        # TTFT flags shared across the batch attempt AND its per-row
+        # retries: a request whose first token fired during the failed
+        # batch must not contribute a second sample from the retry
+        ttft_closed = [False] * len(idx)
         try:
             t0 = clock()
-            outs = interface.complete_tokens_batch(prompts, temps, rls,
-                                                   top_ks=tks, top_ps=tps,
-                                                   rep_penalties=rps)
+            with _decode_progress([enqueues[i] if enqueues else None
+                                   for i in idx], closed=ttft_closed):
+                outs = interface.complete_tokens_batch(prompts, temps, rls,
+                                                       top_ks=tks,
+                                                       top_ps=tps,
+                                                       rep_penalties=rps)
             _record_decode(clock() - t0,
                            sum(max(0, len(o) - min(len(p), kept_limit))
                                for p, o in zip(prompts, outs)))
@@ -262,9 +369,15 @@ def _complete_batch(interface: InterfaceWrapper,
                     continue
                 try:
                     t1 = clock()
-                    out = interface.complete_tokens(
-                        prompts[j], temps[j], rls[j], top_k=tks[j],
-                        top_p=tps[j], repetition_penalty=rps[j])
+                    # ttft_closed[j:j+1] copies the flag's CURRENT value:
+                    # the retry is this request's last decode, so the
+                    # guard only needs the prior attempt's state
+                    with _decode_progress([enqueues[i] if enqueues
+                                           else None],
+                                          closed=ttft_closed[j:j + 1]):
+                        out = interface.complete_tokens(
+                            prompts[j], temps[j], rls[j], top_k=tks[j],
+                            top_p=tps[j], repetition_penalty=rps[j])
                     # retry decodes record too — otherwise the latency
                     # histograms go blind exactly during an incident
                     _record_decode(clock() - t1,
@@ -751,7 +864,9 @@ def _process_group(handlers, interface: InterfaceWrapper,
         batchable = batchable[:1]
     _serve_metrics()["batch"].observe(len(batchable))
     if len(batchable) == 1:
-        rid, path, body = batchable[0][0], batchable[0][1], batchable[0][2]
+        g0 = batchable[0]
+        rid, path, body = g0[0], g0[1], g0[2]
+        enqueue = g0[4] if len(g0) > 4 else None
         try:
             # parse first (once) so malformed input answers 400 WITHOUT
             # touching the breaker; past this point any exception is the
@@ -763,7 +878,7 @@ def _process_group(handlers, interface: InterfaceWrapper,
             respond(rid, _err(e, _BAD_REQUEST))
             return
         try:
-            out = _complete_one(interface, path, parsed)
+            out = _complete_one(interface, path, parsed, enqueue_ts=enqueue)
             if guard is not None:
                 guard.record_decode_success()
             respond(rid, out)
@@ -774,7 +889,9 @@ def _process_group(handlers, interface: InterfaceWrapper,
     elif batchable:
         deadlines = [g[3] if len(g) > 3 else None for g in batchable]
         outs = _complete_batch(interface, [(g[1], g[2]) for g in batchable],
-                               deadlines=deadlines, guard=guard, clock=clock)
+                               deadlines=deadlines, guard=guard, clock=clock,
+                               enqueues=[g[4] if len(g) > 4 else None
+                                         for g in batchable])
         for g, out in zip(batchable, outs):
             respond(g[0], out)
 
@@ -791,6 +908,11 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     ``control``, when given, is populated with live handles for tests/ops
     (``child_pid``, ``state``)."""
     handlers = _handlers(interface)
+    # build identity on every scrape (both server branches render it via
+    # the shared exposition path; in the isolated path it rides the device
+    # loop's published snapshot).  Git rev read once, here — never on the
+    # request path.
+    telemetry.register_build_info()
     if not isolate:
         print(f"serving on :{port} (in-process)")
         return _run_http(port, list(handlers),

@@ -12,6 +12,8 @@ jit compiles the body once, unlike MTF which unrolled compile per shape.
 """
 from __future__ import annotations
 
+import threading
+import time
 import typing
 
 import jax
@@ -20,6 +22,37 @@ import numpy as np
 
 from ..config import ModelParameter
 from ..model import Model
+
+#: decode-progress hook (docs/OBSERVABILITY.md 'Cost attribution'): when
+#: set, the STEPPED decode loop reports ``hook("chunk", dt=..., steps=...,
+#: cache_bytes=...)`` after each donated chunk completes and
+#: ``hook("first_token", rows=[...])`` as each batch row's first generated
+#: token comes to exist (per-row: co-batched prompts of different lengths
+#: fire in different chunks) — the
+#: serving layer (infer/rest_api.py) turns these into TTFT / ITL /
+#: cache-bandwidth metrics.  None (the default) keeps this module free of
+#: telemetry: no clock reads, no per-chunk device sync.
+#: per-THREAD hook storage: the installer thread is always the thread that
+#: runs the decode (device loop in isolated serving, the handler thread
+#: in-process), and in-process servers run handlers concurrently — a
+#: process-global here would let overlapping requests swap each other's
+#: hooks mid-decode and leak a stale one on exit
+_DECODE_PROGRESS = threading.local()
+
+
+def decode_progress_hook() -> typing.Optional[typing.Callable]:
+    """The calling thread's decode-progress hook (None outside serving)."""
+    return getattr(_DECODE_PROGRESS, "hook", None)
+
+
+def set_decode_progress_hook(hook: typing.Optional[typing.Callable]
+                             ) -> typing.Optional[typing.Callable]:
+    """Install the calling thread's decode-progress hook; returns the
+    PREVIOUS hook so callers can restore it (the serving path installs per
+    decode call)."""
+    prev = decode_progress_hook()
+    _DECODE_PROGRESS.hook = hook
+    return prev
 
 
 def _repetition_penalty(logits, seen, rep):
@@ -264,19 +297,24 @@ def _kv_body(model: Model, mesh, logits_filter: bool, variables, ipb, tb,
         cur = jax.lax.dynamic_slice_in_dim(token_x, q, 1, axis=1)
         logits, caches = model.apply_decode(variables, cur, q, caches,
                                             mesh=mesh)
-        logits = logits.astype(jnp.float32)          # [b, 1, tp, v]
-        if logits_filter:
-            logits = _repetition_penalty(logits, seen, rb)
-            logits = _filter_logits(logits, tb, kb, pb)
-        key, sub = jax.random.split(key)
-        u = jax.random.uniform(sub, logits.shape, jnp.float32,
-                               minval=1e-9, maxval=1.0)
-        logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
-        nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
-        old = jax.lax.dynamic_slice_in_dim(token_x, q + 1, 1, axis=1)
-        new = jnp.where(q + 1 >= ipb[:, None, None], nxt, old)
-        token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
-                                                      axis=1)
+        # named-scope region: everything downstream of the model forward is
+        # token SAMPLING (filters, gumbel, argmax, token write) — trace
+        # attribution separates it from cache-read/cache-write and the model
+        # body (docs/OBSERVABILITY.md 'Cost attribution')
+        with jax.named_scope("sampling"):
+            logits = logits.astype(jnp.float32)      # [b, 1, tp, v]
+            if logits_filter:
+                logits = _repetition_penalty(logits, seen, rb)
+                logits = _filter_logits(logits, tb, kb, pb)
+            key, sub = jax.random.split(key)
+            u = jax.random.uniform(sub, logits.shape, jnp.float32,
+                                   minval=1e-9, maxval=1.0)
+            logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
+            nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
+            old = jax.lax.dynamic_slice_in_dim(token_x, q + 1, 1, axis=1)
+            new = jnp.where(q + 1 >= ipb[:, None, None], nxt, old)
+            token_x = jax.lax.dynamic_update_slice_in_dim(token_x, new, q + 1,
+                                                          axis=1)
         if logits_filter:
             # count the newly WRITTEN token (prompt rows not yet at
             # their boundary keep `old`, already counted by seen0)
@@ -477,10 +515,66 @@ def _sample_kv_stepped(model: Model, variables, token_x, initial_pos,
     step = _jit_sampler(model, mesh, "kv_step" + suffix)
     chunk = max(1, int(getattr(p, "decode_chunk_tokens", 64)))
     end_dev = jnp.asarray(end, jnp.int32)
+
+    # decode-progress instrumentation: with no hook installed (the default
+    # outside serving) this adds NOTHING to the loop — no clock reads and
+    # no per-chunk sync; with one, each chunk pays a block on the scalar q
+    # (forces the chunk to completion; trivial next to chunk decode time)
+    hook = decode_progress_hook()
+    # per-ROW first-token thresholds: co-batched prompts of different
+    # lengths reach their first generated token at different chunks, and
+    # TTFT must close per request — a single batch-wide event would record
+    # the longest prompt's TTFT as if it finished with the shortest
+    ipb_row = np.maximum(1, ipb_host.astype(np.int64))
+    first_fired = np.zeros(batch, bool)
+    # cache bytes read EAGERLY: later chunks donate token_x away, and
+    # decode_cache_bytes (shape-only, cached per model) must not touch a
+    # deleted array
+    cache_bytes = decode_cache_bytes(model, variables, token_x) \
+        if hook is not None else 0
+
+    def safe_hook(event: str, **kw):
+        # telemetry must never fail a decode — but say so
+        try:
+            hook(event, **kw)
+        except Exception as exc:
+            import warnings
+            warnings.warn(f"decode-progress hook failed: {exc!r}")
+
+    def run_chunk(call, q_old: int, q_new: int):
+        if hook is None:
+            return call()
+        t0 = time.monotonic()
+        out = call()
+        jax.block_until_ready(out[0])
+        dt = time.monotonic() - t0
+        safe_hook("chunk", dt=dt, steps=max(0, q_new - q_old),
+                  cache_bytes=cache_bytes)
+        newly = np.nonzero(~first_fired & (ipb_row <= q_new))[0]
+        if newly.size:
+            first_fired[newly] = True
+            safe_hook("first_token", rows=newly.tolist())
+        return out
+
+    def flush_first_tokens():
+        # a decode can END with rows that never crossed their first-token
+        # threshold: a zero-chunk early return (end_iterations at/below the
+        # chunk floor) or a prompt longer than the decode budget.  Close
+        # them at completion so every stepped request contributes exactly
+        # one TTFT sample — dropping them would exclude precisely the
+        # cheapest traffic and bias the quantiles upward
+        if hook is None:
+            return
+        rows = np.nonzero(~first_fired)[0]
+        if rows.size:
+            first_fired[rows] = True
+            safe_hook("first_token", rows=rows.tolist())
+
     if prefill:
         # one full forward captures the caches decode steps 0..n0-1 would
         # write (make_kv_sampler documents the q/ipb arithmetic); runs on
-        # the PREPPED token_x so the captured rows match the fused path
+        # the PREPPED token_x so the captured rows match the fused path.
+        # Dispatched async — its time lands in the first steady chunk's dt
         q0 = max(int(ipb_host.min()) - 1, 0)
         caches = _jit_sampler(model, mesh, "kv_prefill_caches")(
             variables, token_x, jnp.asarray(q0, jnp.int32))
@@ -494,18 +588,23 @@ def _sample_kv_stepped(model: Model, variables, token_x, initial_pos,
         # in-program; it returns the full carry for the donated steady loop
         q0, q = 0, min(chunk, end - 1)
         if q <= 0:
+            flush_first_tokens()
             return token_x  # nothing to generate
         carry0 = (jnp.asarray(q0, jnp.int32), token_x, key)
         if filt:
             carry0 = carry0 + (seen0,)
-        carry = _jit_sampler(model, mesh, "kv_step_init" + suffix)(
-            variables, ipb, tb, end_dev, jnp.asarray(q, jnp.int32), fargs,
-            carry0)
+        carry = run_chunk(
+            lambda: _jit_sampler(model, mesh, "kv_step_init" + suffix)(
+                variables, ipb, tb, end_dev, jnp.asarray(q, jnp.int32),
+                fargs, carry0), q0, q)
     while q < end - 1:
         q_hi = min(q + chunk, end - 1)
-        carry = step(variables, ipb, tb, end_dev,
-                     jnp.asarray(q_hi, jnp.int32), fargs, carry)
+        carry = run_chunk(
+            lambda c=carry, qh=q_hi: step(variables, ipb, tb, end_dev,
+                                          jnp.asarray(qh, jnp.int32), fargs,
+                                          c), q, q_hi)
         q = q_hi
+    flush_first_tokens()
     return carry[1]
 
 

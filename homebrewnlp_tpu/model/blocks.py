@@ -749,8 +749,9 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
             # all depth rows land in one scatter at the token position
             starts = [jnp.int32(0)] * arr.ndim
             starts[axis + 1] = state.pos
-            new = jax.lax.dynamic_update_slice(stacked_caches[rel], arr,
-                                               tuple(starts))
+            with jax.named_scope("cache_write"):
+                new = jax.lax.dynamic_update_slice(stacked_caches[rel], arr,
+                                                   tuple(starts))
         if stacked_in:
             # the sampler carries caches depth-stacked: write back verbatim
             state.out[STACKED_CACHE_PREFIX + rel] = new

@@ -203,35 +203,48 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     full_dims = [key_dim_for(state, d) if d == dim else d for d in x.dims]
     store_dtype = state.cache_dtype or x.dtype
     shape = [d.size for d in full_dims]
+    # named-scope regions (docs/OBSERVABILITY.md 'Cost attribution'): the
+    # row scatter is the cache WRITE traffic; the dequant/upcast of the
+    # full buffer on the way back to attention is the cache READ traffic.
+    # cache_read only materializes when the read does real work (int8
+    # dequant, dtype upcast) — a same-dtype astype emits NO op, and forcing
+    # one (optimization_barrier) would block the read-into-attention fusion
+    # just to carry a label, so on default bf16 caches the read bytes are
+    # attributed to the consuming scope (body/attention) instead
     if store_dtype == jnp.int8:
         # per-row symmetric quantization (scale over the trailing feature
         # axis): wide-batch decode is cache-READ-bandwidth-bound
         # (BASELINE.md), so int8 halves the bytes vs bf16 at ~1/127
         # relative error; scales ride a sibling f32 cache (1/F the size)
         _check_int8_layout(name, axis, len(shape))
-        q, scale = _quantize_int8_rows(x.data)
-        buf = _cache(name, shape, jnp.int8)
-        buf = jax.lax.dynamic_update_slice_in_dim(buf, q, state.pos, axis)
-        buf = _constrain_cache(state, buf, full_dims)
-        sname = name + "_scale"
-        sbuf = _cache(sname, shape[:-1] + [1], jnp.float32)
-        sbuf = jax.lax.dynamic_update_slice_in_dim(sbuf, scale, state.pos,
-                                                   axis)
-        sbuf = _constrain_cache(state, sbuf,
-                                full_dims[:-1] + [Dim("_kv_scale", 1)])
+        with jax.named_scope("cache_write"):
+            q, scale = _quantize_int8_rows(x.data)
+            buf = _cache(name, shape, jnp.int8)
+            buf = jax.lax.dynamic_update_slice_in_dim(buf, q, state.pos, axis)
+            buf = _constrain_cache(state, buf, full_dims)
+            sname = name + "_scale"
+            sbuf = _cache(sname, shape[:-1] + [1], jnp.float32)
+            sbuf = jax.lax.dynamic_update_slice_in_dim(sbuf, scale, state.pos,
+                                                       axis)
+            sbuf = _constrain_cache(state, sbuf,
+                                    full_dims[:-1] + [Dim("_kv_scale", 1)])
         state.out[name] = buf
         state.out[sname] = sbuf
         state.row_updates[name] = (q, axis)
         state.row_updates[sname] = (scale, axis)
-        deq = (buf.astype(jnp.float32) * sbuf).astype(x.dtype)
+        with jax.named_scope("cache_read"):
+            deq = (buf.astype(jnp.float32) * sbuf).astype(x.dtype)
         return nt(deq, full_dims)
-    buf = _cache(name, shape, store_dtype)
-    buf = jax.lax.dynamic_update_slice_in_dim(
-        buf, x.data.astype(store_dtype), state.pos, axis)
-    buf = _constrain_cache(state, buf, full_dims)
+    with jax.named_scope("cache_write"):
+        buf = _cache(name, shape, store_dtype)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, x.data.astype(store_dtype), state.pos, axis)
+        buf = _constrain_cache(state, buf, full_dims)
     state.out[name] = buf
     state.row_updates[name] = (x.data.astype(store_dtype), axis)
-    return nt(buf.astype(x.dtype), full_dims)
+    with jax.named_scope("cache_read"):
+        read = buf.astype(x.dtype)
+    return nt(read, full_dims)
 
 
 def prefill_store_kv(x: NamedTensor, dim: Dim) -> None:

@@ -892,22 +892,30 @@ def attention(q, k, v, scale: typing.Optional[float] = None,
     s = q.shape[1]
     blk = kernel_block(s)
     bwq, bwk = _bwd_tiles(s, blk)
+    # named-scope regions (docs/OBSERVABILITY.md 'Cost attribution'): which
+    # attention implementation actually ran — flash kernel vs the dense XLA
+    # fallback — is visible per-op in HLO metadata and profiler traces
     if stash is not None and s % 128 == 0:
         from ..model.blocks import stash_collecting, stash_pop, stash_push
         if stash_collecting(stash):
             if on_tpu:
-                out, lse = _flash_fwd_impl(q, k, v, scale, causal, blk,
-                                           kernel_block(s, cap=2048),
-                                           interpret)
+                with jax.named_scope("flash_attention"):
+                    out, lse = _flash_fwd_impl(q, k, v, scale, causal, blk,
+                                               kernel_block(s, cap=2048),
+                                               interpret)
             else:
-                out, lse = _xla_reference_with_lse(q, k, v, scale, causal)
+                with jax.named_scope("attention_dense"):
+                    out, lse = _xla_reference_with_lse(q, k, v, scale, causal)
             stash_push(stash, (out, lse))
             return out
         out_s, lse_s = stash_pop(stash)
-        return flash_precomputed(q, k, v, out_s, lse_s, scale, causal,
-                                 bwq, bwk, interpret)
+        with jax.named_scope("flash_attention"):
+            return flash_precomputed(q, k, v, out_s, lse_s, scale, causal,
+                                     bwq, bwk, interpret)
     if not on_tpu or s % 128 != 0:
-        return _xla_reference(q, k, v, scale, causal)
-    return flash_attention(q, k, v, scale, causal, blk,
-                           kernel_block(s, cap=2048), interpret,
-                           bwd_block_q=bwq, bwd_block_k=bwk)
+        with jax.named_scope("attention_dense"):
+            return _xla_reference(q, k, v, scale, causal)
+    with jax.named_scope("flash_attention"):
+        return flash_attention(q, k, v, scale, causal, blk,
+                               kernel_block(s, cap=2048), interpret,
+                               bwd_block_q=bwq, bwd_block_k=bwk)

@@ -674,7 +674,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             fn = jax.shard_map(zz_collect, mesh=mesh,
                                in_specs=(spec, spec, spec),
                                out_specs=(spec, lse_spec), check_vma=False)
-            out, lse = fn(q, k, v)
+            with jax.named_scope("ring_attention"):
+                out, lse = fn(q, k, v)
             stash_push(stash, (out, lse))
             return out
 
@@ -691,7 +692,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             fn = jax.shard_map(zz_provide, mesh=mesh,
                                in_specs=(spec, spec, spec, spec, lse_spec),
                                out_specs=spec, check_vma=False)
-            return fn(q, k, v, out_s, lse_s)
+            with jax.named_scope("ring_attention"):
+                return fn(q, k, v, out_s, lse_s)
 
         def zz_fn(q, k, v):
             qz, kz, vz = to_zz3(q, k, v)
@@ -701,13 +703,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
         fn = jax.shard_map(zz_fn, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
-        return fn(q, k, v)
+        with jax.named_scope("ring_attention"):
+            return fn(q, k, v)
     fn = jax.shard_map(
         functools.partial(_ring_core, axis_name, n_shards, causal, scale,
                           block_q),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    return fn(q, k, v)
+    with jax.named_scope("ring_attention"):
+        return fn(q, k, v)
 
 
 def dense_reference(q, k, v, causal=True, scale=None):

@@ -305,8 +305,12 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     tel_nonfinite = tel_preempt = None
     tel_jsonl = None
     tel_jsonl_last = [0.0]
+    tel_mfu = tel_tokens = None
+    mfu_flops_per_step = 0.0
+    mfu_peak_total = 1.0
     if params.telemetry_enabled:
         from .. import telemetry
+        telemetry.register_build_info()
         if params.telemetry_chrome_trace_events:
             tel_trace = telemetry.ChromeTrace(
                 params.telemetry_chrome_trace_events)
@@ -318,9 +322,40 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         tel_preempt = reg.counter(
             "hbnlp_train_preemptions_total",
             "graceful SIGTERM/SIGINT stops (emergency checkpoint written)")
+        # live MFU (docs/OBSERVABILITY.md 'Cost attribution'): analytical
+        # forward FLOPs traced ONCE here (abstract — no device work), the
+        # per-step gauge is ledger-FLOPs / measured step time / peak.
+        # Failure to trace (e.g. exotic video configs) degrades to no gauge,
+        # never to a dead run.
+        tel_tokens = reg.counter(
+            "hbnlp_train_tokens_total",
+            "tokens fed to the device (rate() of this is tokens/sec)")
+        try:
+            from ..utils import flops as flops_mod
+            micro = {k: v[0] if params.macro_batching > 1 else v
+                     for k, v in first_batch.items() if v is not None}
+            fwd = flops_mod.forward_flops(
+                lambda v, b: model.apply(v, b).total_loss.data,
+                state.variables, micro)
+            # 3x-forward convention (forward + 2x backward, no remat
+            # credit) x the micro steps one loop iteration executes
+            mfu_flops_per_step = 3.0 * fwd * max(1, params.macro_batching)
+            mfu_peak_total = flops_mod.peak_flops() * max(1, len(devices))
+            tel_mfu = reg.gauge(
+                "hbnlp_train_mfu",
+                "model FLOPs utilization of the last step (3x-forward "
+                "analytical FLOPs / measured step time / peak)")
+        except Exception as exc:
+            print(f"WARNING: MFU gauge disabled (FLOP trace failed: {exc})",
+                  flush=True)
         if is_chief and params.telemetry_jsonl_interval_s > 0:
             tel_jsonl = fs.open_(fs.join(params.model_path,
                                          "telemetry.jsonl"), "a")
+            # header line: every later snapshot line in this file joins
+            # back to the build that produced it
+            tel_jsonl.write(json.dumps(
+                {"build_info": telemetry.build_info()}) + "\n")
+            tel_jsonl.flush()
     # on-demand XLA profiling is independent of telemetry_enabled: it has
     # zero per-step cost until a SIGUSR2 actually requests a capture
     profiler_od = None
@@ -434,7 +469,15 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 # step time — dispatch of the NEXT step is sub-ms and the
                 # prefetcher keeps data decode off this thread)
                 jax.block_until_ready(metrics["loss"])
-                phases.device_block.rec(t1, mono() - t1)
+                t2 = mono()
+                phases.device_block.rec(t1, t2 - t1)
+                if tel_tokens is not None:
+                    tel_tokens.inc(tokens_per_step)
+                if tel_mfu is not None and t2 > t0:
+                    # dispatch + device time of THIS step; the clock reads
+                    # are the ones the phases above already paid
+                    tel_mfu.set(mfu_flops_per_step / (t2 - t0)
+                                / mfu_peak_total)
             consumed += params.macro_batching
             if params.nonfinite_loss_tolerance > 0:
                 # the jitted step already SKIPPED the update on-device for a

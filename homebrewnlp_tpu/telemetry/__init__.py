@@ -16,6 +16,7 @@ ZERO registry calls unless ``telemetry_enabled`` is set; rare-event layers
 (storage retries, checkpoint IO, serving decode rounds) record always —
 their cadence is storage/request-bound, never per-step.
 """
+from .buildinfo import build_info, register_build_info
 from .profiler import OnDemandProfiler
 from .registry import (DEFAULT_BUCKETS, Registry, histogram_quantile,
                        jsonl_line, merge_snapshots, prometheus_text,
@@ -28,5 +29,5 @@ __all__ = [
     "merge_snapshots", "prometheus_text", "registry", "render_json",
     "set_registry", "snapshot", "summarize",
     "SPAN_METRIC", "ChromeTrace", "Phase", "StepPhases", "span",
-    "OnDemandProfiler",
+    "OnDemandProfiler", "build_info", "register_build_info",
 ]

@@ -52,6 +52,13 @@ def _escape(value: str) -> str:
             .replace('"', '\\"'))
 
 
+def _escape_help(value: str) -> str:
+    """HELP-line escaping: format 0.0.4 escapes ONLY backslash and line
+    feed here — a double quote must pass through verbatim (label-value
+    escaping is the stricter three-character rule above)."""
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_str(names: typing.Sequence[str], values: LabelValues) -> str:
     if not names:
         return ""
@@ -225,7 +232,7 @@ def prometheus_text(*snapshots: dict) -> str:
     for name in sorted(snap):
         m = snap[name]
         if m["help"]:
-            lines.append(f"# HELP {name} {_escape(m['help'])}")
+            lines.append(f"# HELP {name} {_escape_help(m['help'])}")
         lines.append(f"# TYPE {name} {m['kind']}")
         labelnames = tuple(m.get("labels", ()))
         for key in sorted(m["series"]):
@@ -299,6 +306,14 @@ def merge_snapshots(*snapshots: dict) -> dict:
                                  for k, v in m["series"].items()}}
                 continue
             tgt = out[name]
+            if m["kind"] == "histogram" and \
+                    list(m.get("buckets", ())) != list(tgt["buckets"]):
+                # zip() over mismatched bucket lists would silently drop
+                # counts; processes must agree on boundaries to merge
+                raise ValueError(
+                    f"histogram {name}: bucket boundaries differ between "
+                    f"snapshots ({tgt['buckets']} vs "
+                    f"{list(m.get('buckets', ()))}) — cannot merge")
             for key, val in m["series"].items():
                 cur = tgt["series"].get(key)
                 if cur is None or m["kind"] == "gauge":

@@ -174,8 +174,12 @@ class Trainer:
         batch, rng = batch_rng
         variables, opt_state, step = carry
         grads, info = self._grads(variables, batch, rng)
-        new_vars, new_opt, lr = self.optimizer.update(variables, grads, opt_state,
-                                                      step)
+        # named-scope region: the update's ops attribute to "optimizer" in
+        # HLO metadata / traces instead of blending into the model scopes
+        # (docs/OBSERVABILITY.md 'Cost attribution')
+        with jax.named_scope("optimizer"):
+            new_vars, new_opt, lr = self.optimizer.update(variables, grads,
+                                                          opt_state, step)
         metrics = {
             **_grad_norm_metrics(grads, self.params.debug_gradients),
             **_info_metrics(info),
@@ -199,7 +203,9 @@ class Trainer:
 
         zero = {k: jnp.zeros(v.shape, jnp.float32) for k, v in variables.items()}
         grads, sub_metrics = jax.lax.scan(scan_fn, zero, (batch, rng))
-        new_vars, new_opt, lr = self.optimizer.update(variables, grads, opt_state, step)
+        with jax.named_scope("optimizer"):
+            new_vars, new_opt, lr = self.optimizer.update(variables, grads,
+                                                          opt_state, step)
         metrics = {
             **_grad_norm_metrics(grads, self.params.debug_gradients),
             **{k: jnp.mean(v) for k, v in sub_metrics.items()},

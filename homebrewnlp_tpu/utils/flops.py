@@ -29,6 +29,23 @@ PEAK_TFLOPS = {
 }
 
 
+# sustained HBM bandwidth per chip by device kind (bytes/s): the other half
+# of the roofline — arithmetic intensity above PEAK_TFLOPS/bandwidth is
+# compute-bound, below it HBM-bound.  Published chip figures; the cpu row is
+# a nominal planning figure so CPU-rig ledgers still classify
+HBM_BANDWIDTH = {
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,        # v5p
+    "TPU v5p": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v4 lite": 614e9,
+    "TPU v6 lite": 1640e9,   # v6e / Trillium
+    "TPU v6e": 1640e9,
+    "cpu": 50e9,             # nominal planning figure
+}
+
+
 # HBM bytes per chip by device kind; the axon tunnel returns no
 # memory_stats, so capacity planning (stash auto-enable, fused-backward
 # dq-partial cap) keys on the kind string
@@ -66,16 +83,37 @@ def device_hbm_bytes(device: typing.Optional[jax.Device] = None) -> int:
     return HBM_BYTES["cpu"]
 
 
+def _kind_lookup(table: typing.Mapping[str, float], kind: str) -> float:
+    if kind in table:
+        return table[kind]
+    for name, val in table.items():
+        if name.lower() in str(kind).lower():
+            return val
+    return table["cpu"]
+
+
 def peak_flops(device: typing.Optional[jax.Device] = None) -> float:
     if device is None:
         device = jax.devices()[0]
-    kind = getattr(device, "device_kind", "cpu")
-    if kind in PEAK_TFLOPS:
-        return PEAK_TFLOPS[kind]
-    for name, peak in PEAK_TFLOPS.items():
-        if name.lower() in str(kind).lower():
-            return peak
-    return PEAK_TFLOPS["cpu"]
+    return _kind_lookup(PEAK_TFLOPS, getattr(device, "device_kind", "cpu"))
+
+
+def peak_hbm_bandwidth(device: typing.Optional[jax.Device] = None) -> float:
+    """Sustained HBM bytes/s for the device kind (table above) — the decode
+    cache-read roofline PR 2 proved governs big-cache serving."""
+    if device is None:
+        device = jax.devices()[0]
+    return _kind_lookup(HBM_BANDWIDTH, getattr(device, "device_kind", "cpu"))
+
+
+def roofline_bound(flops: float, bytes_: float,
+                   peak: float, bandwidth: float) -> str:
+    """``"compute"`` when the arithmetic intensity (flops/byte) clears the
+    ridge point ``peak/bandwidth``, else ``"hbm"`` — the classification the
+    cost ledger records per scope (analysis/cost_ledger.py)."""
+    if bytes_ <= 0:
+        return "compute" if flops > 0 else "hbm"
+    return "compute" if flops / bytes_ >= peak / bandwidth else "hbm"
 
 
 def _dot_flops(eqn) -> int:
@@ -124,39 +162,61 @@ def count_matmul_flops_split(jaxpr) -> typing.Tuple[int, int]:
     return total, total - dead
 
 
+def _descend(eqn):
+    """``(inner_jaxpr, trip_multiplier)`` of a higher-order equation, or
+    None for leaves.  The ONE primitive/param-key table both jaxpr walkers
+    (:func:`_count_split` and :func:`_scope_walk`) descend through — a jax
+    upgrade renaming a param key gets fixed here once, instead of letting
+    the MFU count and the cost ledger silently disagree.  ``cond`` and
+    ``pallas_call`` are excluded: their conventions differ per walker
+    (max-branch vs dead-cell accounting) but share :func:`_pallas_grid`."""
+    prim = eqn.primitive.name
+    if prim == "scan":
+        return eqn.params["jaxpr"].jaxpr, int(eqn.params["length"])
+    if prim == "while":
+        # trip count unknown; count one body iteration
+        return eqn.params["body_jaxpr"].jaxpr, 1
+    if prim in ("custom_vjp_call", "custom_jvp_call",
+                "custom_vjp_call_jaxpr", "remat", "checkpoint"):
+        inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+    elif prim in ("pjit", "jit", "xla_call", "closed_call", "core_call",
+                  "shard_map"):
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+    else:
+        return None
+    if inner is None:
+        return None
+    return getattr(inner, "jaxpr", inner), 1
+
+
+def _pallas_grid(eqn):
+    """``(inner_jaxpr_or_None, grid, cells)`` of a ``pallas_call`` — the
+    kernel body runs once per grid cell, so FLOPs are grid product × body
+    FLOPs."""
+    inner = eqn.params.get("jaxpr")
+    gm = eqn.params.get("grid_mapping")
+    grid = getattr(gm, "grid", ()) if gm is not None else ()
+    cells = int(np.prod([g for g in grid if isinstance(g, int)],
+                        dtype=np.int64)) if grid else 1
+    return (getattr(inner, "jaxpr", inner) if inner is not None else None,
+            grid, cells)
+
+
 def _count_split(jaxpr) -> typing.Tuple[int, int]:
     """Recursive core: (full-square total, causally-dead) FLOPs."""
     total = 0
     dead = 0
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
-        if prim == "dot_general":
+        inner = _descend(eqn)
+        if inner is not None:
+            t, d = _count_split(inner[0])
+            total += inner[1] * t
+            dead += inner[1] * d
+        elif prim == "dot_general":
             total += _dot_flops(eqn)
         elif prim == "conv_general_dilated":
             total += _conv_flops(eqn)
-        elif prim == "scan":
-            t, d = _count_split(eqn.params["jaxpr"].jaxpr)
-            total += eqn.params["length"] * t
-            dead += eqn.params["length"] * d
-        elif prim == "while":
-            # trip count unknown; count one body iteration
-            t, d = _count_split(eqn.params["body_jaxpr"].jaxpr)
-            total += t
-            dead += d
-        elif prim in ("custom_vjp_call", "custom_jvp_call",
-                      "custom_vjp_call_jaxpr", "remat", "checkpoint"):
-            inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
-            if inner is not None:
-                t, d = _count_split(getattr(inner, "jaxpr", inner))
-                total += t
-                dead += d
-        elif prim in ("pjit", "jit", "xla_call", "closed_call", "core_call",
-                      "shard_map"):
-            inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
-            if inner is not None:
-                t, d = _count_split(getattr(inner, "jaxpr", inner))
-                total += t
-                dead += d
         elif prim == "cond":
             branches = eqn.params.get("branches", ())
             if branches:
@@ -165,23 +225,17 @@ def _count_split(jaxpr) -> typing.Tuple[int, int]:
                 total += t
                 dead += d
         elif prim == "pallas_call":
-            # kernel body runs once per grid cell (e.g. the flash-attention
-            # QK^T/PV block matmuls); grid product x body FLOPs.  Every grid
-            # cell is counted as if live in ``total`` — the full-square
-            # convention for causal flash kernels, kept stable
+            # every grid cell counted as if live in ``total`` — the
+            # full-square convention for causal flash kernels, kept stable
             # round-over-round.  Causal kernels (name carries "causal";
             # grid (batch·heads, a, b) with {a, b} = {q blocks, k blocks}
             # in either order) additionally report their skipped cells in
             # ``dead``: live block pairs are the ones overlapping the lower
             # triangle, sum_j min(b, ceil(j·b/a)) — transpose-symmetric, so
             # the (i, q, k) and (i, k, q) grids count identically
-            inner = eqn.params.get("jaxpr")
-            gm = eqn.params.get("grid_mapping")
-            grid = getattr(gm, "grid", ()) if gm is not None else ()
-            cells = int(np.prod([g for g in grid if isinstance(g, int)],
-                                dtype=np.int64)) if grid else 1
-            if inner is not None:
-                body = _pallas_body_flops(getattr(inner, "jaxpr", inner))
+            body_jaxpr, grid, cells = _pallas_grid(eqn)
+            if body_jaxpr is not None:
+                body = _pallas_body_flops(body_jaxpr)
                 total += cells * body
                 name = str(eqn.params.get("name", "") or "")
                 if "causal" in name and len(grid) == 3 \
@@ -237,3 +291,83 @@ def mfu(fwd_flops_per_step: float, step_time_s: float, n_chips: int = 1,
         device: typing.Optional[jax.Device] = None) -> float:
     """Model FLOPs utilization: 3x forward FLOPs over peak (no remat credit)."""
     return 3.0 * fwd_flops_per_step / step_time_s / (peak_flops(device) * n_chips)
+
+
+# ---- per-scope cost attribution (docs/OBSERVABILITY.md) ---------------------
+#
+# The model graph carries jax.named_scope regions (core/scope.py name_scope
+# mirrors every scope frame), so each jaxpr equation's
+# ``source_info.name_stack`` names the block/layer that produced it.  The
+# walker below attributes {matmul flops, unfused bytes} to those stacks —
+# the analytical half of the cost ledger (analysis/cost_ledger.py), which
+# folds stacks into coarse scope keys and joins them with XLA's
+# cost_analysis and profiler time shares.
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    try:
+        return int(np.prod(aval.shape, dtype=np.int64)
+                   ) * np.dtype(aval.dtype).itemsize
+    except TypeError:
+        return 0
+
+
+def _eqn_bytes(eqn) -> int:
+    """Operand + result bytes of one equation — the UNFUSED memory-traffic
+    convention (fusion elides intermediates on real hardware, so per-scope
+    byte totals are an upper bound; shares between scopes stay meaningful
+    because the convention is uniform)."""
+    return (sum(_aval_bytes(v) for v in eqn.invars)
+            + sum(_aval_bytes(v) for v in eqn.outvars))
+
+
+def scope_costs(jaxpr, prefix: str = ""
+                ) -> typing.Dict[str, typing.Tuple[int, int]]:
+    """``{name_stack: (flops, bytes)}`` over a (closed) jaxpr.
+
+    Scan bodies multiply by trip count (the full-square convention of
+    :func:`count_matmul_flops`); inner jaxprs' stacks are prefixed with the
+    enclosing equation's stack, since a sub-trace's name_stack restarts at
+    its own trace boundary."""
+    out: typing.Dict[str, typing.List[int]] = {}
+    _scope_walk(getattr(jaxpr, "jaxpr", jaxpr), prefix, 1, out)
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def _join_stack(prefix: str, stack: str) -> str:
+    if prefix and stack:
+        return f"{prefix}/{stack}"
+    return prefix or stack
+
+
+def _scope_walk(jaxpr, prefix: str, mult: int, out) -> None:
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        path = _join_stack(prefix, str(eqn.source_info.name_stack))
+        inner = _descend(eqn)
+        if inner is not None:
+            _scope_walk(inner[0], path, mult * inner[1], out)
+            continue
+        if prim == "cond":
+            branches = eqn.params.get("branches", ())
+            if branches:
+                # the max-flops branch, matching count_matmul_flops
+                best = max(branches,
+                           key=lambda b: count_matmul_flops(b.jaxpr))
+                _scope_walk(best.jaxpr, path, mult, out)
+                continue
+        flops = 0
+        if prim == "dot_general":
+            flops = _dot_flops(eqn)
+        elif prim == "conv_general_dilated":
+            flops = _conv_flops(eqn)
+        elif prim == "pallas_call":
+            body_jaxpr, _grid, cells = _pallas_grid(eqn)
+            if body_jaxpr is not None:
+                flops = cells * _pallas_body_flops(body_jaxpr)
+        ent = out.setdefault(path, [0, 0])
+        ent[0] += mult * flops
+        ent[1] += mult * _eqn_bytes(eqn)

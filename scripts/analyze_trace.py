@@ -7,11 +7,17 @@ Usage:
 
 Works on the ``plugins/profile/<ts>/*.trace.json.gz`` files that
 ``jax.profiler.start_trace`` writes (the train loop's ``profile_steps``
-option, run/train_loop.py).  The tensorboard profile plugin's converters are
-broken against this image's TF, and XLA dump flags don't reach the
-tunnel-side compiler — parsing the chrome-trace events by name is the
-methodology that produced the round-1/2 analyses in docs/PERFORMANCE.md
-(SURVEY.md §5.1: the reference had no op-level profiling at all).
+option, run/train_loop.py; SIGUSR2 on-demand captures land in the same
+format).  Device-side XLA events carry the HLO instruction name in
+``args.hlo_op`` — that is the selection rule here, replacing the fragile
+name-prefix heuristics the round-1/2 analyses used (kept only as a
+fallback for traces predating the ``hlo_op`` args).  For per-model-SCOPE
+attribution (which block spent the time, joined against the cost ledger)
+use ``scripts/attribute_step.py``.
+
+A trace with zero device-side events fails LOUDLY (nonzero exit naming the
+file) instead of printing an empty table — an empty capture window or a
+host-only trace must not read as "nothing is slow".
 """
 import argparse
 import collections
@@ -19,19 +25,37 @@ import glob
 import gzip
 import json
 import os
+import sys
 
 
-def load_events(path: str):
+def resolve_trace_file(path: str) -> str:
+    """The actual ``*.trace.json.gz`` behind ``path`` (dir or file) — named
+    in every error so a bad capture is diagnosable."""
     if os.path.isdir(path):
         hits = sorted(glob.glob(os.path.join(
             path, "**", "*.trace.json.gz"), recursive=True))
         if not hits:
             raise SystemExit(f"no *.trace.json.gz under {path}")
-        path = hits[-1]
-    with gzip.open(path) as f:
+        return hits[-1]
+    return path
+
+
+def load_events(path: str):
+    """Every complete ('X') event with a duration from the newest trace
+    file under ``path``."""
+    trace_file = resolve_trace_file(path)
+    with gzip.open(trace_file) as f:
         trace = json.load(f)
-    return [e for e in trace["traceEvents"]
+    return [e for e in trace.get("traceEvents", [])
             if e.get("ph") == "X" and e.get("dur")]
+
+
+def device_events(events):
+    """The device-side XLA op events: those carrying ``args.hlo_op`` (the
+    HLO instruction name) — the reliable selector on every backend this
+    rig profiles."""
+    return [e for e in events
+            if isinstance(e.get("args"), dict) and e["args"].get("hlo_op")]
 
 
 def categorize(name: str) -> str:
@@ -48,7 +72,14 @@ def categorize(name: str) -> str:
             ("convert", "bitcast", "copy", "transpose")):
         return "convert/copy/transpose"
     if name.startswith("fusion"):
+        # unprefixed fusion.N instructions are XLA's output/dot fusions
         return "fusion (dot-rooted)"
+    if "fusion" in name:
+        # loop_fusion/input_fusion are elementwise/reduce bodies — lumping
+        # them with dot fusions would overstate matmul time and hide
+        # elementwise overhead (op-named CPU fusions like
+        # convert_bitcast_fusion land in the branches above)
+        return "fusion (loop/elementwise)"
     return "other: " + name.split(".")[0].split("(")[0][:32]
 
 
@@ -60,38 +91,69 @@ def main():
     ap.add_argument("--top", type=int, default=25)
     args = ap.parse_args()
 
+    trace_file = resolve_trace_file(args.trace)
     evs = load_events(args.trace)
+    if not evs:
+        raise SystemExit(f"{trace_file}: trace contains zero timed events "
+                         "— empty capture window?")
+    dev = device_events(evs)
+    if dev:
+        # device events name their HLO op exactly — but the window can
+        # include other jitted programs (a warm-up compile, an interleaved
+        # eval) whose one-off events would inflate ms/step: keep only the
+        # DOMINANT module's events, and ops seen at least once per step
+        # (attribute_step.py applies the same module discipline via
+        # ENTRY_MODULES)
+        mod_time = collections.Counter()
+        for e in dev:
+            mod_time[e["args"].get("hlo_module", "")] += e["dur"]
+        top_mod = mod_time.most_common(1)[0][0]
+        skipped = len(mod_time) - 1
+        if skipped:
+            print(f"note: keeping module {top_mod!r}; ignoring {skipped} "
+                  "other module(s) in the window "
+                  f"({', '.join(sorted(m for m in mod_time if m != top_mod))})")
+        named = [(e["args"]["hlo_op"], e["dur"]) for e in dev
+                 if e["args"].get("hlo_module", "") == top_mod]
+        cnt_all = collections.Counter(n for n, _ in named)
+
+        def keep(name: str) -> bool:
+            return cnt_all[name] >= args.steps
+    else:
+        # legacy traces without hlo_op args: the old name heuristics —
+        # wrapper/marker events are python frames, pjit spans, and the bare
+        # per-step queue markers ("2"/"5"/"8" in those traces)
+        named = [(e["name"], e["dur"]) for e in evs]
+        prefix_skip = ("jit_", "Pjit", "$", "np.", "while",
+                       "ThreadpoolListener", "Tfrt", "ParseArguments",
+                       "ThunkExecutor")
+        exact_skip = {"2", "5", "8"}
+        cnt_all = collections.Counter(n for n, _ in named)
+
+        def keep(name: str) -> bool:
+            return (cnt_all[name] >= args.steps
+                    and not name.startswith(prefix_skip)
+                    and name not in exact_skip)
+
     agg = collections.Counter()
     cnt = collections.Counter()
-    for e in evs:
-        agg[e["name"]] += e["dur"]
-        cnt[e["name"]] += 1
-
-    # wrapper/marker events, not device ops: python frames, pjit spans, and
-    # the bare per-step queue markers ("2"/"5"/"8" in these traces)
-    prefix_skip = ("jit_", "Pjit", "$", "np.", "while")
-    exact_skip = {"2", "5", "8"}
-
-    def keep(name: str) -> bool:
-        return (cnt[name] >= args.steps
-                and not name.startswith(prefix_skip)
-                and name not in exact_skip)
+    for name, dur in named:
+        if keep(name):
+            agg[name] += dur
+            cnt[name] += 1
+    if not agg:
+        raise SystemExit(
+            f"{trace_file}: trace contains zero device-side events "
+            "(no args.hlo_op and nothing past the legacy filters) — "
+            "was the capture window empty, or host-only?")
 
     print(f"== top ops (us summed over trace; /{args.steps} steps) ==")
-    shown = 0
-    for name, dur in agg.most_common():
-        if not keep(name):
-            continue
+    for i, (name, dur) in enumerate(agg.most_common(args.top)):
         print(f"{dur / 1e3 / args.steps:10.2f} ms/step  x{cnt[name]:6d}  "
               f"{name[:90]}")
-        shown += 1
-        if shown >= args.top:
-            break
 
     cats = collections.Counter()
     for name, dur in agg.items():
-        if not keep(name):
-            continue
         cats[categorize(name)] += dur
     total = sum(cats.values())
     print(f"\n== categories ({total / 1e3 / args.steps:.1f} ms/step "
@@ -99,6 +161,8 @@ def main():
     for cat, dur in cats.most_common(15):
         print(f"{dur / 1e3 / args.steps:10.2f} ms/step  "
               f"{dur / total * 100:5.1f}%  {cat}")
+    print("\nper-model-scope attribution (time vs FLOPs vs bytes share): "
+          f"python scripts/attribute_step.py {args.trace}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Attribute profiler trace time to model scopes and join the cost ledger.
+
+Usage:
+    python scripts/attribute_step.py <trace_dir_or_trace.json.gz>
+        [--entry train_step] [--steps N] [--hlo FILE] [--ledger FILE]
+        [--top K]
+
+The "top offenders" table ROADMAP item 2 calls for: per named scope (the
+``jax.named_scope`` regions core/scope.py mirrors into the model graph),
+
+    measured device-time share  vs  FLOPs share  vs  bytes share,
+
+joined from three artifacts:
+
+1. the trace — device events carry the HLO instruction name
+   (``args.hlo_op``);
+2. the compiled entry point — its HLO text maps instruction ->
+   ``metadata op_name`` -> scope (``--hlo`` loads a saved ``.as_text()``
+   dump; default recompiles the audit entry on this backend, which matches
+   a trace captured from the same config/jax/backend);
+3. the committed cost ledger (``analysis/cost_ledger.json``) — per-scope
+   FLOPs/bytes shares and roofline bound.
+
+Scopes whose time share exceeds BOTH their FLOPs and bytes share are
+flagged ``<<`` — time spent neither computing nor moving the bytes the
+model asked for is pure overhead, the first place ROADMAP item 2's
+0.38 -> 0.55+ MFU hunt should look.
+
+Fails loudly (nonzero exit naming the file) on a trace with zero
+device-side events or one that never ran the requested entry's module.
+"""
+import argparse
+import collections
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import analyze_trace  # noqa: E402  (sibling script: loader + loud failures)
+
+#: entry point -> compiled module name (the ``HloModule <name>`` the trace
+#: tags device events with via ``args.hlo_module``)
+ENTRY_MODULES = {
+    "train_step": "jit_step_fn",
+    "decode_chunk_step": "jit_step",
+    "prefill_entry_step": "jit_step",
+    "eval_fn": "jit_eval_fn",
+}
+
+
+def module_of(hlo_text: str) -> str:
+    """The module name off the ``HloModule <name>`` header line."""
+    for line in hlo_text.splitlines():
+        if line.startswith("HloModule"):
+            return line.split()[1].rstrip(",")
+    return ""
+
+
+def attribute(events, hlo_text: str, ledger_entry=None):
+    """``(rows, unattributed_share, total_us)`` — rows are dicts with
+    scope/time_share/flops_share/bytes_share/bound/overhead, sorted by time
+    share.  Pure function over loaded data (unit-tested on a fixture)."""
+    from homebrewnlp_tpu.analysis import cost_ledger
+    table = cost_ledger.instruction_table(hlo_text)
+    per_scope, unattr, total = cost_ledger.attribute_events(events, table)
+    scopes = (ledger_entry or {}).get("scopes", {})
+    rows = []
+    for scope, dur in sorted(per_scope.items(), key=lambda kv: -kv[1]):
+        share = dur / total if total else 0.0
+        led = scopes.get(scope, {})
+        fs = led.get("flops_share")
+        bs = led.get("bytes_share")
+        overhead = (scope != "unattributed" and fs is not None
+                    and bs is not None
+                    and share > fs + 0.02 and share > bs + 0.02)
+        rows.append({"scope": scope, "time_us": dur, "time_share": share,
+                     "flops_share": fs, "bytes_share": bs,
+                     "bound": led.get("bound"), "overhead": overhead})
+    unattributed = per_scope.get("unattributed", 0.0) / total if total \
+        else 0.0
+    return rows, unattributed, total
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="trace dir or *.trace.json.gz")
+    ap.add_argument("--entry", default="train_step",
+                    choices=sorted(ENTRY_MODULES),
+                    help="which audited entry point the trace ran")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="traced step count (ms/step normalisation)")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--hlo", default=None,
+                    help="saved compiled-HLO text of the traced program "
+                         "(e.g. <model_path>/train_step.stablehlo.txt is "
+                         "NOT it — use compiled .as_text(); default: "
+                         "recompile the audit entry on this backend)")
+    ap.add_argument("--ledger", default=None,
+                    help="alternate cost_ledger.json")
+    args = ap.parse_args(argv)
+
+    trace_file = analyze_trace.resolve_trace_file(args.trace)
+    evs = analyze_trace.device_events(analyze_trace.load_events(args.trace))
+    if not evs:
+        raise SystemExit(f"{trace_file}: trace contains zero device-side "
+                         "events (args.hlo_op) — empty capture window, or "
+                         "host-only trace?")
+
+    if args.hlo:
+        with open(args.hlo) as f:
+            hlo = f.read()
+        module = module_of(hlo) or ENTRY_MODULES[args.entry]
+    else:
+        from homebrewnlp_tpu.analysis import entry_points
+        print(f"compiling audit entry {args.entry!r} for the instruction->"
+              "scope map...", file=sys.stderr)
+        hlo, _ = entry_points.lower_one(args.entry)
+        module = module_of(hlo) or ENTRY_MODULES[args.entry]
+
+    by_module = collections.Counter(
+        e["args"].get("hlo_module", "?") for e in evs)
+    picked = [(e["args"]["hlo_op"], e["dur"]) for e in evs
+              if e["args"].get("hlo_module") == module]
+    if not picked:
+        raise SystemExit(
+            f"{trace_file}: no device events for module {module!r} "
+            f"(entry {args.entry}); modules present: "
+            f"{dict(by_module.most_common(8))}")
+
+    from homebrewnlp_tpu.analysis import cost_ledger
+    ledger = cost_ledger.load_ledger(args.ledger)
+    ledger_entry = (ledger or {}).get("entry_points", {}).get(args.entry)
+    if ledger_entry is None:
+        print(f"WARNING: no committed ledger entry for {args.entry!r}; "
+              "flops/bytes columns will be empty", file=sys.stderr)
+
+    rows, unattributed, total = attribute(picked, hlo, ledger_entry)
+
+    other = sum(c for m, c in by_module.items() if m != module)
+    print(f"== {args.entry} scope attribution "
+          f"({total / 1e3 / args.steps:.2f} ms/step device time, "
+          f"module {module}; {other} events of other modules ignored) ==")
+    hdr = (f"{'scope':28s} {'ms/step':>9s} {'time%':>7s} {'flops%':>7s} "
+           f"{'bytes%':>7s} {'bound':>8s}")
+    print(hdr)
+
+    def pct(v):
+        return f"{v * 100:6.1f}%" if v is not None else "      -"
+
+    for row in rows[:args.top]:
+        flag = "  << overhead" if row["overhead"] else ""
+        print(f"{row['scope']:28s} "
+              f"{row['time_us'] / 1e3 / args.steps:9.2f} "
+              f"{pct(row['time_share'])} {pct(row['flops_share'])} "
+              f"{pct(row['bytes_share'])} "
+              f"{(row['bound'] or '-'):>8s}{flag}")
+    print(f"\nunattributed device time: {unattributed * 100:.1f}% "
+          "(growing share = scope annotations or the HLO join broke)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

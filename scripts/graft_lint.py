@@ -34,10 +34,16 @@ def run_ast() -> list:
     return ast_lint.lint_repo()
 
 
-def run_hlo(budgets_path=None) -> list:
-    from homebrewnlp_tpu.analysis import entry_points, hlo_lint
+def run_hlo(budgets_path=None, ledger_path=None) -> list:
+    from homebrewnlp_tpu.analysis import cost_ledger, entry_points, hlo_lint
     budgets = hlo_lint.load_budgets(budgets_path) if budgets_path else None
-    return entry_points.audit_all(budgets=budgets)
+    # one lower_all feeds BOTH the HLO audits and the cost-ledger
+    # regression check — the four entry-point compiles are the cost here,
+    # shared so --all stays within its ~20s CPU budget
+    lowered = entry_points.lower_all()
+    findings = entry_points.audit_lowered(lowered, budgets=budgets)
+    findings += cost_ledger.ledger_audit(lowered, path=ledger_path)
+    return findings
 
 
 def main(argv=None) -> int:
@@ -51,6 +57,9 @@ def main(argv=None) -> int:
     ap.add_argument("--budgets", default=None,
                     help="alternate budgets.json (default: "
                          "analysis/budgets.json)")
+    ap.add_argument("--ledger", default=None,
+                    help="alternate cost_ledger.json (default: "
+                         "analysis/cost_ledger.json)")
     args = ap.parse_args(argv)
     do_ast = args.ast or args.all or not (args.ast or args.hlo)
     do_hlo = args.hlo or args.all or not (args.ast or args.hlo)
@@ -60,7 +69,7 @@ def main(argv=None) -> int:
     if do_ast:
         findings += run_ast()
     if do_hlo:
-        findings += run_hlo(args.budgets)
+        findings += run_hlo(args.budgets, args.ledger)
     dt = time.monotonic() - t0
 
     for f in findings:

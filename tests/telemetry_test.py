@@ -183,6 +183,94 @@ def merge_and_render_test():
     assert telemetry.histogram_quantile((1.0,), [0, 0], 0.5) is None
 
 
+def merged_histogram_inf_cumulativity_test():
+    """Exposition of a MERGED snapshot stays conformant: bucket counts are
+    cumulative-monotone and the +Inf bucket equals _count equals the total
+    observation count across both source processes (the /metrics scrape
+    path renders merge_snapshots output, so the invariant must survive the
+    merge, not just a single registry)."""
+    ra, rb = telemetry.Registry(), telemetry.Registry()
+    ha = ra.histogram("m_seconds", "merged", ("op",), buckets=(0.1, 1, 10))
+    hb = rb.histogram("m_seconds", "merged", ("op",), buckets=(0.1, 1, 10))
+    for v in (0.05, 0.5, 99.0):
+        ha.labels(op="w").observe(v)
+    for v in (0.1, 3.0, 50.0, 7.0):       # 0.1 inclusive in first bucket
+        hb.labels(op="w").observe(v)
+    # the multi-snapshot prometheus_text path merges internally
+    types, series = _parse_exposition(
+        telemetry.prometheus_text(ra.snapshot(), rb.snapshot()))
+    assert types["m_seconds"] == "histogram"
+    cum = [series[("m_seconds_bucket", f'op="w",le="{b}"')]
+           for b in ("0.1", "1", "10", "+Inf")]
+    assert cum == [2, 3, 5, 7]            # monotone, both processes summed
+    assert series[("m_seconds_bucket", 'op="w",le="+Inf"')] \
+        == series[("m_seconds_count", 'op="w"')] == 7
+    assert series[("m_seconds_sum", 'op="w"')] == pytest.approx(159.65)
+
+
+def merge_bucket_mismatch_rejected_test():
+    """Snapshots whose histograms disagree on bucket boundaries refuse to
+    merge (a silent zip() would drop counts from the longer list)."""
+    ra, rb = telemetry.Registry(), telemetry.Registry()
+    ra.histogram("mm_seconds", buckets=(1.0, 2.0)).observe(0.5)
+    rb.histogram("mm_seconds", buckets=(1.0, 2.0, 4.0)).observe(0.5)
+    with pytest.raises(ValueError, match="mm_seconds.*bucket"):
+        telemetry.merge_snapshots(ra.snapshot(), rb.snapshot())
+
+
+def help_and_label_escaping_test():
+    """Format 0.0.4 has TWO escaping rules: HELP text escapes only
+    backslash and line feed (a double quote passes through verbatim);
+    label values additionally escape the double quote."""
+    r = telemetry.Registry()
+    weird = 'say "hi"\\\n done'
+    r.counter("esc2_total", weird, ("v",)).labels(v=weird).inc()
+    text = telemetry.prometheus_text(r.snapshot())
+    help_line = [ln for ln in text.splitlines()
+                 if ln.startswith("# HELP esc2_total ")][0]
+    assert help_line == '# HELP esc2_total say "hi"\\\\\\n done'
+    sample = [ln for ln in text.splitlines()
+              if ln.startswith("esc2_total{")][0]
+    assert sample == 'esc2_total{v="say \\"hi\\"\\\\\\n done"} 1'
+    # exposition stays one-line-per-sample: no raw newline leaked
+    assert all("\n" not in ln for ln in (help_line, sample))
+
+
+def gauge_last_wins_interleaved_test():
+    """Gauge merge semantics under interleaved publishes from two
+    processes: the LAST snapshot argument wins per series — even when its
+    value is 0/falsy — series absent from later snapshots survive from
+    earlier ones, and counters keep summing regardless of order."""
+    dev, child = telemetry.Registry(), telemetry.Registry()
+    g_dev = dev.gauge("depth", "queue depth", ("q",))
+    g_child = child.gauge("depth", "queue depth", ("q",))
+    c_dev, c_child = dev.counter("n_total"), child.counter("n_total")
+    g_dev.labels(q="a").set(5)
+    g_dev.labels(q="b").set(7)          # only the device loop publishes b
+    c_dev.inc(2)
+    snap_dev1 = dev.snapshot()
+    g_child.labels(q="a").set(3)
+    c_child.inc(1)
+    snap_child = child.snapshot()
+    g_dev.labels(q="a").set(0)          # falsy newest value must still win
+    c_dev.inc(4)
+    snap_dev2 = dev.snapshot()
+
+    # scrape 1 lands between the two device publishes: child passed last
+    m1 = telemetry.merge_snapshots(snap_dev1, snap_child)
+    assert m1["depth"]["series"][("a",)] == 3       # later argument wins
+    assert m1["depth"]["series"][("b",)] == 7       # absent later: survives
+    assert m1["n_total"]["series"][()] == 3         # counters sum
+    # scrape 2 sees the fresher device publish last: its 0 must still win
+    m2 = telemetry.merge_snapshots(snap_child, snap_dev2)
+    assert m2["depth"]["series"][("a",)] == 0
+    assert m2["depth"]["series"][("b",)] == 7
+    assert m2["n_total"]["series"][()] == 7
+    # argument order IS the tiebreak: same snapshots, flipped, flip the gauge
+    assert telemetry.merge_snapshots(
+        snap_dev2, snap_child)["depth"]["series"][("a",)] == 3
+
+
 def span_and_chrome_trace_test():
     r = telemetry.Registry()
     trace = telemetry.ChromeTrace(max_events=3)
@@ -351,11 +439,25 @@ def train_step_phase_breakdown_test(tmp_path, fresh_registry):
         assert sum(state["counts"]) >= steps - 1, phase
         assert state["sum"] >= 0
     assert snap["hbnlp_prefetch_items_total"]["series"][("train",)] >= steps
-    # the JSONL trajectory parses and carries the span series
+    # live MFU + token throughput (docs/OBSERVABILITY.md 'Cost
+    # attribution'): a real utilization in (0, 1] and every consumed token
+    # counted; the build-info gauge identifies the run
+    assert 0 < snap["hbnlp_train_mfu"]["series"][()] <= 1
+    tokens_per_step = (cfg["train_batch_size"] * cfg["sequence_length"]
+                       * max(1, cfg.get("macro_batching", 1)))
+    assert snap["hbnlp_train_tokens_total"]["series"][()] \
+        == steps * tokens_per_step
+    build_series = snap["hbnlp_build_info"]["series"]
+    assert len(build_series) == 1 and list(build_series.values()) == [1]
+    # the JSONL trajectory parses and carries the span series; its header
+    # line joins the file to the build that wrote it
     jsonl = os.path.join(cfg["model_path"], "telemetry.jsonl")
     lines = [json.loads(x) for x in open(jsonl)]
+    assert set(lines[0]["build_info"]) == {"git_rev", "jax_version",
+                                           "backend", "device_kind"}
     assert lines and telemetry.SPAN_METRIC in lines[-1]["metrics"]
     assert lines[-1]["step"] == steps
+    assert "hbnlp_train_mfu" in lines[-1]["metrics"]
     # the chrome trace is valid and its spans carry durations
     trace = json.load(open(os.path.join(cfg["model_path"],
                                         "telemetry_trace.json")))
