@@ -62,6 +62,10 @@ DONATED_JIT_REGISTRY: typing.Dict[str, str] = {
         "decode_chunk_step (harness)",
     "homebrewnlp_tpu/analysis/entry_points.py::lower_prefill_entry":
         "prefill_entry_step (harness)",
+    # the continuous-batching engine's chunk step (all three variants —
+    # init/admit/plain — share one jit site; the steady-state program is
+    # audited as "engine_chunk_step")
+    "homebrewnlp_tpu/infer/engine.py::_engine_jit": "engine_chunk_step",
 }
 
 

@@ -54,7 +54,7 @@ AUDIT_CONFIG: typing.Dict[str, typing.Any] = {
 
 #: audited entry points, in budgets.json key order
 ENTRY_POINTS = ("train_step", "decode_chunk_step", "prefill_entry_step",
-                "eval_fn")
+                "eval_fn", "engine_chunk_step")
 
 
 def build_audit_model(overrides: typing.Optional[dict] = None, seed: int = 0):
@@ -245,6 +245,53 @@ def lower_prefill_entry(model, variables, token_x,
     return hlo, context
 
 
+def lower_engine_step(model, variables, token_x, mesh=None):
+    """Compiled donated continuous-batching engine chunk step — the
+    slot-pool analogue of ``decode_chunk_step``: the donated carry holds the
+    ENTIRE fixed-slot KV pool (per-slot rows of every cache leaf), and the
+    audit pins that every pool leaf aliases input->output with no
+    full-pool-shaped copy, per-slot position vector and all
+    (infer/engine.py; docs/SERVING.md).
+
+    Audits the steady-state ``engine_plain`` variant — the program every
+    decode chunk between admissions runs; abstract avals throughout, same
+    OOM-safety argument as ``lower_decode_step``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..infer.engine import _engine_jit
+    from ..infer.sampler import decode_cache_shapes
+
+    aval = jax.ShapeDtypeStruct
+    batch = token_x.shape[0]
+    shapes = decode_cache_shapes(model, variables, token_x)
+    caches = {k: aval(v.shape, v.dtype) for k, v in shapes.items()}
+    step = _engine_jit(model, mesh, "engine_plain")
+    vec_i = aval((batch,), jnp.int32)
+    vec_f = aval((batch,), jnp.float32)
+    scalar = aval((), jnp.int32)
+    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
+    seen = aval((batch, model.params.vocab_size), jnp.float32)
+    carry = (vec_i, aval(tuple(token_x.shape), token_x.dtype), caches, key,
+             seen)
+    fargs = (vec_i, vec_f, vec_f)
+    args = (variables, vec_i, vec_f, vec_i, scalar, fargs, (), carry)
+    compiled = step.lower(*args).compile()
+    hlo = compiled.as_text()
+    context = {
+        # q + token_x + key + seen ride the donated carry next to the pool
+        "donated_leaves": len(shapes) + 4,
+        "protected": hlo_lint.shape_strings(shapes, key_filter="/kv"),
+        "cache_shapes": shapes,
+        "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
+                                              dtypes={"bf16"}),
+        "compiled": compiled,
+        "trace": lambda: step.trace(*args).jaxpr,
+    }
+    return hlo, context
+
+
 def _filter_args(batch: int, logits_filter: bool):
     import jax
     import jax.numpy as jnp
@@ -278,6 +325,8 @@ def lower_all(overrides: typing.Optional[dict] = None
                                                     jnp.asarray(token_x))
     out["eval_fn"] = lower_eval_fn(params, model, variables, batch,
                                    trainer=trainer, state=state)
+    out["engine_chunk_step"] = lower_engine_step(model, variables,
+                                                 jnp.asarray(token_x))
     return out
 
 
@@ -301,6 +350,8 @@ def lower_one(entry: str, overrides: typing.Optional[dict] = None
                              trainer=trainer, state=state)
     if entry == "decode_chunk_step":
         return lower_decode_step(model, variables, jnp.asarray(token_x))
+    if entry == "engine_chunk_step":
+        return lower_engine_step(model, variables, jnp.asarray(token_x))
     return lower_prefill_entry(model, variables, jnp.asarray(token_x))
 
 
@@ -327,7 +378,8 @@ def audit_lowered(lowered: "typing.Dict[str, typing.Tuple[str, dict]]",
                              * ctx["donated_bytes"]),
         budget=train_budget)
 
-    for entry in ("decode_chunk_step", "prefill_entry_step"):
+    for entry in ("decode_chunk_step", "prefill_entry_step",
+                  "engine_chunk_step"):
         hlo, ctx = lowered[entry]
         findings += hlo_lint.audit(
             entry, hlo,

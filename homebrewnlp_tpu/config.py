@@ -320,6 +320,33 @@ class ModelParameter:
         # permanently wedged loop.  0 = off (a long decode also ages the
         # heartbeat — pick a threshold above the worst-case decode)
         self.serve_heartbeat_stale_s = 0.0
+        # ---- continuous-batching serving engine (docs/SERVING.md) ----
+        # which device loop serves completions on the isolated REST path:
+        # "batch" = batch-to-completion (drain -> one decode -> answer all,
+        # the pre-engine behavior), "continuous" = the slot-pool engine
+        # (iteration-level scheduling: admit/evict between donated chunk
+        # steps, per-slot end detection; REQUIRES a text model with a
+        # streaming decode form — serve() refuses to start otherwise),
+        # "auto" = continuous when the deployment can carry it, batch
+        # fallback otherwise (stub interfaces, video models)
+        self.serve_engine = "auto"
+        # engine slot-pool width: requests decoding concurrently in ONE
+        # donated chunk step; KV-pool HBM and per-step compute scale
+        # linearly with it (the engine analogue of serve_batch_size)
+        self.serve_slots = 8
+        # per-dispatch iteration budget while any admitted request is still
+        # walking its prompt region (prefill interleaved with decode):
+        # larger reaches the long prompt's first token in fewer host
+        # round-trips, smaller re-checks admit/evict/answer more often —
+        # scheduling only happens at chunk boundaries.  Steady-state decode
+        # uses decode_chunk_tokens
+        self.serve_prefill_chunk_tokens = 128
+        # ---- persistent compilation cache (ROADMAP item 5, first sliver) --
+        # directory for jax's persistent XLA compilation cache
+        # (jax_compilation_cache_dir): warm restarts, run_manager
+        # relaunches, and serving-child respawns skip the ~100s
+        # compile+warmup tax when the program is unchanged.  "" = off
+        self.compile_cache_dir = ""
         # ---- telemetry (docs/OBSERVABILITY.md) ----
         # master switch for TRAIN-LOOP instrumentation: step-phase histograms
         # (data-wait / dispatch / device-block), prefetcher gauges, JSONL /
@@ -388,6 +415,17 @@ class ModelParameter:
             raise ValueError("serve_request_deadline_s must be > 0 (it is "
                              "the default deadline, not just a cap), got "
                              f"{self.serve_request_deadline_s}")
+        # tri-state like decode_loop: a typo would silently serve through
+        # the wrong engine
+        if self.serve_engine not in ("auto", "batch", "continuous"):
+            raise ValueError("serve_engine must be \"auto\", \"batch\" or "
+                             f"\"continuous\", got {self.serve_engine!r}")
+        if self.serve_slots < 1:
+            raise ValueError("serve_slots must be >= 1, got "
+                             f"{self.serve_slots}")
+        if self.serve_prefill_chunk_tokens < 1:
+            raise ValueError("serve_prefill_chunk_tokens must be >= 1, got "
+                             f"{self.serve_prefill_chunk_tokens}")
         # the serving-default repetition penalty reaches _repetition_penalty
         # whenever a request omits a value (sample mode, REPL, batched
         # rows); r <= 0 would inf/NaN seen tokens' logits — apply the same

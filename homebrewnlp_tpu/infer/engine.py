@@ -1,0 +1,318 @@
+"""Continuous-batching decode engine: a fixed-slot KV pool on device.
+
+The batch-to-completion serving path (``infer/rest_api.py`` + ``sampler``)
+assembles a batch, decodes EVERY row to its end, then answers — one long
+request pins its whole co-batch, and KV memory is provisioned per batch at
+worst-case length.  This module is the device half of iteration-level
+scheduling on top of PR 2's stepped decode substrate:
+
+* **slot pool** — one donated decode carry sized ``serve_slots`` wide holds
+  per-slot rows of every cache leaf (int8-composable: the sibling scale
+  caches ride the same pool).  Allocated once, in-trace, on the first
+  dispatch; every subsequent chunk step donates it, so XLA's
+  input_output_aliases pin all cache updates in place (the PR 2 property,
+  audited on the compiled module as ``engine_chunk_step`` by graft-lint).
+* **per-slot positions** — the chunk step carries an int32 position VECTOR:
+  co-resident requests decode at independent positions (model/decode.py
+  ``scatter_rows`` + the vector-pos branches in compare_range/_embed), so a
+  newly admitted request walks its prompt region while residents keep
+  generating — prefill interleaved with decode at iteration granularity.
+* **admit between chunks** — admission rides the chunk step itself: the
+  ``engine_admit`` variant splices new prompt rows into the donated
+  ``token_x``, resets the admitted slots' positions and ``seen`` counts, and
+  zeroes their cache rows (a per-leaf elementwise select — the
+  non-idempotent recurrence caches, cumsum totals and conv windows, must not
+  inherit the previous occupant's state; KV rows would self-heal through the
+  per-row causal mask but are cleared uniformly).  Finished slots are simply
+  parked (``end_pos = 0``): their rows stop advancing and anything the pool
+  still holds for them is dead weight the next admission overwrites.
+* **per-slot end detection** — a slot is finished when its position reaches
+  its own ``end_pos - 1``; the host reads back positions + tokens after
+  every chunk (one small D2H of ``token_x``, never the cache pool), answers
+  finished rows immediately and recycles their slots.
+
+Sampling semantics match the stepped loop's ``_kv_body`` walk bit-for-bit
+for greedy requests (tests/continuous_batching_test.py pins token-for-token
+parity); the logits-filter machinery is always compiled in — with filters at
+their disabled defaults it is an exact identity on the argmax, so the one
+program serves both.  Temperature>0 rows draw per-step gumbel noise from one
+engine-wide stream (the per-token distribution is identical to the batch
+path; the realized stream depends on co-residency, like any shared-rng
+batched sampler).
+
+Host-side scheduling (FIFO admission, deadlines, breaker interplay) lives in
+``infer/scheduler.py`` — device-free, so the state machine tests run without
+jax work.  ``infer/rest_api.py`` wires both into the serving device loop
+(config ``serve_engine`` auto/batch/continuous).
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from ..config import ModelParameter
+from ..model import Model
+
+
+def _engine_jit(model: Model, mesh, kind: str):
+    """Per-model cache of the jitted engine steps (mirrors
+    ``sampler._jit_sampler`` — a fresh closure per dispatch would re-trace
+    every chunk)."""
+    import jax
+
+    from ..model import blocks as blocks_mod
+    from .sampler import (_filter_logits, _repetition_penalty,
+                          decode_cache_shapes)
+
+    cache = model.__dict__.setdefault("_engine_jit_cache", {})
+    cache_key = (mesh, kind)
+    if cache_key in cache:
+        return cache[cache_key]
+    import jax.numpy as jnp
+
+    init_caches = kind == "engine_init"
+    admit = kind in ("engine_init", "engine_admit")
+
+    def step(variables, ipb, tb, end_pos, steps, fargs, admit_args, carry):
+        kb, pb, rb = fargs
+        if init_caches:
+            q, token_x, key, seen = carry
+            # pool built INSIDE the donated trace (like kv_step_init): a
+            # serving mesh constrains its sharding in-program, and no
+            # unusable host-side zero copy ever exists
+            caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
+                      decode_cache_shapes(model, variables, token_x).items()}
+        else:
+            q, token_x, caches, key, seen = carry
+        batch, seq = token_x.shape[0], token_x.shape[1]
+        rows3 = jnp.arange(batch)[:, None, None]
+        if admit:
+            mask, new_rows = admit_args
+            token_x = jnp.where(mask[:, None, None], new_rows, token_x)
+            q = jnp.where(mask, jnp.zeros_like(q), q)
+            # seed the admitted rows' repetition-penalty counts from their
+            # prompt region (the _kv_prep formula — ipb==0 rows count the
+            # parity-zeroed index 0); resident rows keep their counts
+            pmask = (jnp.arange(seq)[None, :, None]
+                     < jnp.maximum(ipb, 1)[:, None, None]).astype(jnp.float32)
+            seeded = jnp.zeros_like(seen).at[rows3, token_x].add(pmask)
+            seen = jnp.where(mask[:, None], seeded, seen)
+            if not init_caches:
+                # evict the previous occupant's state from the admitted
+                # slots: elementwise per-leaf select (no full-pool copy —
+                # the HLO audit checks), batch axis 1 on depth-stacked
+                # leaves, 0 on flat ones
+                for name in list(caches):
+                    leaf = caches[name]
+                    baxis = 1 if name.startswith(
+                        blocks_mod.STACKED_CACHE_PREFIX) else 0
+                    bshape = [1] * leaf.ndim
+                    bshape[baxis] = batch
+                    caches[name] = jnp.where(
+                        mask.reshape(bshape),
+                        jnp.zeros((), leaf.dtype), leaf)
+        end_pos = jnp.minimum(end_pos, seq)
+
+        def cond_fn(state):
+            it, qv = state[0], state[1]
+            return (it < steps) & jnp.any(qv < end_pos - 1)
+
+        def body_fn(state):
+            it, qv, token_x, caches, key, seen = state
+            active = qv < end_pos - 1
+            qc = jnp.clip(qv, 0, seq - 1)
+            cur = jnp.take_along_axis(token_x, qc[:, None, None], axis=1)
+            logits, caches = model.apply_decode(variables, cur, qc, caches,
+                                                mesh=mesh)
+            with jax.named_scope("sampling"):
+                logits = logits.astype(jnp.float32)      # [b, 1, tp, v]
+                logits = _repetition_penalty(logits, seen, rb)
+                logits = _filter_logits(logits, tb, kb, pb)
+                key, sub = jax.random.split(key)
+                u = jax.random.uniform(sub, logits.shape, jnp.float32,
+                                       minval=1e-9, maxval=1.0)
+                logits = logits + (jnp.log(-jnp.log(u))
+                                   * (-tb[:, None, None, None]))
+                nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
+                qp1 = qc + 1
+                old = jnp.take_along_axis(
+                    token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
+                # write q+1 only for rows that are live AND past their own
+                # prompt boundary — walking rows keep consuming their prompt
+                write = active & (qp1 >= ipb)
+                new = jnp.where(write[:, None, None], nxt, old)
+                token_x = token_x.at[jnp.arange(batch), qp1].set(
+                    jnp.squeeze(new, 1), mode="drop")
+            seen = seen.at[rows3, new].add(
+                write.astype(jnp.float32)[:, None, None])
+            qv = qv + active.astype(qv.dtype)
+            return it + 1, qv, token_x, caches, key, seen
+
+        state = (jnp.int32(0), q, token_x, caches, key, seen)
+        _, q, token_x, caches, key, seen = jax.lax.while_loop(
+            cond_fn, body_fn, state)
+        return q, token_x, caches, key, seen
+
+    # the carry (argument 7) is DONATED: every cache-pool leaf must alias
+    # input->output — the invariant graft-lint's engine_chunk_step audit
+    # pins on the compiled module (docs/STATIC_ANALYSIS.md)
+    cache[cache_key] = jax.jit(step, donate_argnums=(7,))
+    return cache[cache_key]
+
+
+class EngineExecutor:
+    """Device half of the continuous engine: the slot pool, its host-side
+    argument mirrors, and the donated dispatch.
+
+    Raises ``NotImplementedError`` at construction for models the stepped
+    decode path cannot serve (video mode, layers without a streaming form)
+    — ``rest_api`` falls back to the batch engine on that signal.
+    """
+
+    def __init__(self, interface, slots: int,
+                 seed: typing.Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from .sampler import decode_cache_bytes, decode_cache_shapes
+
+        p: ModelParameter = interface.params
+        if p.use_video or not p.use_language:
+            raise NotImplementedError("the continuous engine decodes text "
+                                      "(gpt-mode) models only")
+        self.interface = interface
+        self.slots = int(slots)
+        self.params_w, self.model_w = interface._model_for_width(self.slots)
+        self.variables = interface.variables
+        self.mesh = interface.mesh
+        self.seq = p.sequence_length // p.token_patch_size
+        self.tps = p.token_patch_size
+        probe = np.zeros((self.slots, self.seq, self.tps), np.int32)
+        # probes the streaming form now (NotImplementedError -> batch
+        # fallback) and pins the pool's byte size for the bandwidth gauges
+        self.cache_bytes = decode_cache_bytes(self.model_w, self.variables,
+                                              probe)
+        # ALSO trace one decode step with a VECTOR position, abstractly:
+        # the per-slot-only guards (batch-less KV layouts _batch_leading
+        # cannot broadcast in place, multi-axis position embeddings, a
+        # vector-trace cache layout diverging from the scalar-derived pool)
+        # fire inside the step trace, not in the shape probe above — they
+        # must fail CONSTRUCTION so serve_engine="auto" falls back to the
+        # batch engine instead of 500ing every dispatch forever
+        shapes = decode_cache_shapes(self.model_w, self.variables, probe)
+        aval = jax.ShapeDtypeStruct
+        jax.eval_shape(
+            lambda v, t, c: self.model_w.apply_decode(
+                v, t, jnp.zeros(self.slots, jnp.int32), c, mesh=self.mesh),
+            self.variables, aval((self.slots, 1, self.tps), jnp.int32),
+            {k: aval(v.shape, v.dtype) for k, v in shapes.items()})
+        # per-slot dispatch arguments (host mirrors; idle slots are inert:
+        # end_pos 0 never activates)
+        self.ipb = np.full(self.slots, self.seq - 1, np.int32)
+        self.tb = np.zeros(self.slots, np.float32)
+        self.end_pos = np.zeros(self.slots, np.int32)
+        self.top_k = np.full(self.slots, int(p.sampling_top_k), np.int32)
+        self.top_p = np.full(self.slots, float(p.sampling_top_p), np.float32)
+        self.rep = np.full(self.slots,
+                           float(p.sampling_repetition_penalty), np.float32)
+        self.q = np.zeros(self.slots, np.int64)
+        self._defaults = (int(p.sampling_top_k), float(p.sampling_top_p),
+                          float(p.sampling_repetition_penalty))
+        self._admit_mask = np.zeros(self.slots, bool)
+        self._admit_rows = np.zeros((self.slots, self.seq, self.tps),
+                                    np.int32)
+        self._token_host = np.zeros((self.slots, self.seq, self.tps),
+                                    np.int32)
+        self._carry = None
+        self._key0 = jax.random.PRNGKey(p.data_seed if seed is None
+                                        else seed)
+        # prompt padding beyond each admitted row mirrors the batch path's
+        # pad_random convention (inert under causal masking — parity
+        # surface only); seeded so reruns are reproducible
+        self._pad_rng = np.random.default_rng(p.data_seed)
+        self._jnp = jnp
+
+    # -- slot staging --------------------------------------------------------
+
+    def admit(self, slot: int, req) -> None:
+        """Stage ``req`` (an ``infer.scheduler.EngineRequest``) into
+        ``slot``; takes effect inside the next dispatch's admit splice."""
+        p = self.params_w
+        row = self._pad_rng.integers(0, p.vocab_size,
+                                     (self.seq, self.tps)).astype(np.int32)
+        toks = np.asarray(req.toks, np.int32).reshape(-1)[:self.seq - 1]
+        row[:len(toks), :] = toks[:, None]
+        if len(toks) == 0:
+            # _kv_prep parity: an empty prompt's position 0 is zeroed (the
+            # full sampler's first iteration writes 0 there)
+            row[0, :] = 0
+        self._admit_rows[slot] = row
+        self._admit_mask[slot] = True
+        self.ipb[slot] = len(toks)
+        self.tb[slot] = float(req.temperature)
+        self.end_pos[slot] = req.end_pos(self.seq)
+        tk, tp, rp = self._defaults
+        self.top_k[slot] = int(req.top_k) if req.top_k is not None else tk
+        self.top_p[slot] = float(req.top_p) if req.top_p is not None else tp
+        self.rep[slot] = (float(req.rep_penalty)
+                          if req.rep_penalty is not None else rp)
+        self.q[slot] = 0
+
+    def release(self, slot: int) -> None:
+        """Park a finished/evicted slot: inert until the next admission."""
+        self.end_pos[slot] = 0
+        self.ipb[slot] = self.seq - 1
+        self._admit_mask[slot] = False
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, steps: int) -> np.ndarray:
+        """Run one donated chunk (up to ``steps`` iterations per slot; the
+        compiled loop exits early once every live slot reaches its end).
+        Returns the post-chunk position vector; ``tokens()`` serves rows
+        from the same read-back.  Any exception leaves the donated carry
+        unusable — callers must ``reset()`` (the controller does)."""
+        jnp = self._jnp
+        kind = ("engine_init" if self._carry is None else
+                "engine_admit" if self._admit_mask.any() else "engine_plain")
+        fn = _engine_jit(self.model_w, self.mesh, kind)
+        fargs = (jnp.asarray(self.top_k), jnp.asarray(self.top_p),
+                 jnp.asarray(self.rep))
+        if kind == "engine_init":
+            seen = jnp.zeros((self.slots, self.params_w.vocab_size),
+                             jnp.float32)
+            carry = (jnp.zeros(self.slots, jnp.int32),
+                     jnp.asarray(self._token_host), self._key0, seen)
+        else:
+            carry = self._carry
+        admit_args = ()
+        if kind != "engine_plain":
+            admit_args = (jnp.asarray(self._admit_mask),
+                          jnp.asarray(self._admit_rows))
+        out = fn(self.variables, jnp.asarray(self.ipb), jnp.asarray(self.tb),
+                 jnp.asarray(self.end_pos), jnp.int32(int(steps)), fargs,
+                 admit_args, carry)
+        q, token_x = out[0], out[1]
+        self._carry = out
+        # one small D2H per chunk (positions + tokens, never the pool):
+        # end detection and answer extraction read these
+        self._token_host = np.asarray(token_x)
+        self.q = np.asarray(q).astype(np.int64)
+        self._admit_mask[:] = False
+        return self.q
+
+    def tokens(self, slot: int) -> np.ndarray:
+        """The slot's token row from the last dispatch read-back, sliced to
+        its own end (lane 0, matching ``complete_tokens``'s return)."""
+        end = int(self.end_pos[slot])
+        return self._token_host[slot, :end, 0]
+
+    def reset(self) -> None:
+        """Drop the pool (next dispatch re-initialises it in-trace) and
+        park every slot — the recovery path after a failed dispatch."""
+        self._carry = None
+        self._admit_mask[:] = False
+        self.end_pos[:] = 0
+        self.ipb[:] = self.seq - 1
+        self.q[:] = 0

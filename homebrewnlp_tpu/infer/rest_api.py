@@ -124,6 +124,34 @@ def _serve_metrics() -> dict:
                 "last chunk's cache read bandwidth over the device's peak "
                 "HBM bandwidth — ~1.0 means decode sits ON the roofline "
                 "PR 2 proved governs it"),
+            # continuous-batching engine series (docs/OBSERVABILITY.md +
+            # docs/SERVING.md): slot occupancy + the two queueing-theory
+            # histograms the capacity model needs, plus lifecycle counters
+            "slots_occupied": r.gauge(
+                "hbnlp_serve_slots_occupied",
+                "engine slots holding a resident request (continuous "
+                "engine)"),
+            "slots_total": r.gauge(
+                "hbnlp_serve_slots_total",
+                "configured engine slot-pool width (serve_slots)"),
+            "queue_age": r.histogram(
+                "hbnlp_serve_queue_age_seconds",
+                "seconds a request waited in the engine's pending queue "
+                "before a slot freed (observed at admission)"),
+            "slot_residency": r.histogram(
+                "hbnlp_serve_slot_residency_seconds",
+                "seconds a request occupied its slot, admission to "
+                "answer/eviction"),
+            "admitted": r.counter(
+                "hbnlp_serve_engine_admitted_total",
+                "requests admitted into an engine slot"),
+            "evicted": r.counter(
+                "hbnlp_serve_engine_evicted_total",
+                "deadline-expired residents evicted at a chunk boundary "
+                "(each answered 504 exactly once)"),
+            "recycled": r.counter(
+                "hbnlp_serve_engine_recycled_total",
+                "finished slots recycled for the next admission"),
         }
     return _SERVE_METRICS
 
@@ -896,6 +924,159 @@ def _process_group(handlers, interface: InterfaceWrapper,
             respond(g[0], out)
 
 
+# ---- continuous-batching engine wiring (docs/SERVING.md) --------------------
+
+def _resolve_engine(params: ModelParameter, interface):
+    """Build the continuous engine's executor, or None for the batch path.
+
+    ``serve_engine``: "batch" never builds one; "continuous" requires one
+    (construction failure is a config error and raises); "auto" serves
+    through the engine when the interface can carry it — a real
+    ``InterfaceWrapper`` over a text model with a streaming decode form —
+    and falls back to batch-to-completion otherwise (stub interfaces, video
+    models, layers without a streaming form)."""
+    mode = str(getattr(params, "serve_engine", "auto") or "auto")
+    if mode == "batch":
+        return None
+    try:
+        from .engine import EngineExecutor
+        slots = max(1, int(getattr(params, "serve_slots", 8) or 1))
+        return EngineExecutor(interface, slots)
+    except Exception as e:
+        if mode == "continuous":
+            raise RuntimeError(
+                "serve_engine=continuous but the engine cannot serve this "
+                f"deployment: {e!r}") from e
+        print(f"continuous engine unavailable ({e!r}); serving "
+              "batch-to-completion")
+        return None
+
+
+def _engine_answer_fn(interface, respond):
+    """Adapter: scheduler outcomes -> the responses-dict payload contract
+    (same status/code shapes as the batch path, so clients cannot tell the
+    engines apart on errors)."""
+    kept_limit = _prompt_capacity(interface)
+
+    def answer(req, outcome):
+        kind = outcome[0]
+        if kind == "ok":
+            try:
+                payload = _format_completion(interface, req.path, req.toks,
+                                             outcome[1], kept_limit)
+            except Exception as e:  # e.g. a tokenizer decode fault — the
+                # request still gets exactly one (error) answer instead of
+                # the exception killing the device loop
+                payload = _err(e, _SERVER_ERROR)
+        elif kind == "timeout":
+            where = ("in its slot" if outcome[1] == "slot"
+                     else "in the queue")
+            payload = _err(f"request expired {where} ({req.path})", _TIMEOUT)
+        elif kind == "unavailable":
+            payload = {**_err("circuit breaker open: decode is failing",
+                              _UNAVAILABLE), "_retry_after": outcome[1]}
+        else:  # ("error", exc) — a failed engine dispatch
+            payload = _err(outcome[1], _SERVER_ERROR)
+        respond(req.rid, payload)
+
+    return answer
+
+
+def _engine_hooks_fn(interface, scheduler, executor):
+    """Adapter: controller events -> /metrics series (slot occupancy, queue
+    age, residency, admitted/evicted/recycled, TTFT/ITL, cache bandwidth)."""
+    m = _serve_metrics()
+    m["slots_total"].set(executor.slots)
+
+    def hooks(event, **kw):
+        # telemetry must never fail a decode round — but say so (the
+        # stepped loop's safe_hook rule)
+        try:
+            _record(event, **kw)
+        except Exception as exc:
+            import warnings
+            warnings.warn(f"engine metrics hook failed: {exc!r}")
+
+    def _record(event, **kw):
+        now = time.monotonic()
+        if event == "chunk":
+            interface.decode_calls += 1
+            dt, steps = float(kw.get("dt") or 0.0), int(kw.get("steps") or 0)
+            m["decode"].observe(dt)
+            if steps > 0 and dt > 0:
+                m["itl"].observe(dt / steps)
+                gen = int(kw.get("generated") or 0)
+                if gen:
+                    m["tps"].observe(gen / dt)
+                cb = int(kw.get("cache_bytes") or 0)
+                if cb:
+                    bps = cb * steps / dt
+                    m["cache_bps"].set(bps)
+                    peak = _hbm_peak()
+                    if peak:
+                        m["cache_bw_frac"].set(bps / peak)
+        elif event == "first_token":
+            for req in kw.get("reqs", ()):
+                start = (req.enqueue_ts if req.enqueue_ts is not None
+                         else req.submitted_ts)
+                m["ttft"].observe(max(0.0, now - start))
+        elif event == "admitted":
+            m["admitted"].inc()
+            m["queue_age"].observe(float(kw.get("queue_age") or 0.0))
+        elif event == "evicted":
+            m["evicted"].inc()
+        elif event == "recycled":
+            m["recycled"].inc()
+            m["slot_residency"].observe(float(kw.get("residency") or 0.0))
+        m["slots_occupied"].set(len(scheduler.resident))
+
+    return hooks
+
+
+def _engine_classify(handlers, interface, responses, group, clock):
+    """Split one drained IPC group for the engine loop: tokenizer-only
+    paths answer inline (never touch the device — breaker-exempt, like the
+    batch loop), parse failures answer 400 immediately (never
+    breaker-counted), and well-formed completions become EngineRequests."""
+    from .scheduler import EngineRequest
+    now = clock()
+    qw = _serve_metrics()["queue_wait"]
+    new_requests = []
+
+    def respond(rid, payload):
+        responses[rid] = {"t": now, "r": payload}
+
+    for g in group:
+        rid, path, body = g[0], g[1], g[2]
+        deadline = g[3] if len(g) > 3 else None
+        enqueue = g[4] if len(g) > 4 else None
+        if enqueue is not None:
+            qw.observe(max(0.0, now - enqueue))
+        if deadline is not None and now >= deadline:
+            respond(rid, _err(f"request expired in the queue ({path})",
+                              _TIMEOUT))
+            continue
+        if path not in BATCHED_PATHS:
+            try:
+                respond(rid, handlers[path](body))
+            except _CLIENT_ERRORS as e:
+                respond(rid, _err(e, _BAD_REQUEST))
+            except Exception as e:
+                respond(rid, _err(e, _SERVER_ERROR))
+            continue
+        try:
+            toks, temp, rl, tk, tp, rp = _parse_completion(interface, path,
+                                                           body)
+        except Exception as e:
+            respond(rid, _err(e, _BAD_REQUEST))
+            continue
+        new_requests.append(EngineRequest(
+            rid=rid, path=path, toks=toks, temperature=temp,
+            response_len=rl, top_k=tk, top_p=tp, rep_penalty=rp,
+            deadline=deadline, enqueue_ts=enqueue))
+    return new_requests
+
+
 def serve(params: ModelParameter, interface: InterfaceWrapper,
           workers: int = 1, port: int = DEFAULT_PORT, isolate: bool = True,
           stop: typing.Optional[typing.Any] = None,
@@ -937,7 +1118,28 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
         decode_path = interface.decode_path()
     except Exception:
         decode_path = None  # e.g. video models / stub interfaces
-    state.update(model_loaded=True, decode_path=decode_path, inflight=0)
+    # engine selection (docs/SERVING.md): continuous batching when the
+    # deployment can carry it; the executor owns the device-side slot pool,
+    # the controller the host-side scheduling, and this loop only feeds them
+    executor = _resolve_engine(params, interface)
+    controller = None
+    if executor is not None:
+        from .scheduler import EngineController, SlotScheduler
+        scheduler = SlotScheduler(executor.slots)
+
+        def _respond(rid, payload):
+            responses[rid] = {"t": time.monotonic(), "r": payload}
+
+        controller = EngineController(
+            executor, scheduler, guard=guard,
+            decode_chunk=int(getattr(params, "decode_chunk_tokens", 64)),
+            prefill_chunk=int(getattr(params, "serve_prefill_chunk_tokens",
+                                      128) or 128),
+            answer=_engine_answer_fn(interface, _respond),
+            hooks=_engine_hooks_fn(interface, scheduler, executor))
+    state.update(model_loaded=True, decode_path=decode_path, inflight=0,
+                 engine={"mode": "continuous" if controller else "batch",
+                         "slots": executor.slots if executor else 0})
     guard.publish(state, interface)
 
     def spawn_child():
@@ -974,6 +1176,7 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     # the budget bounds crash LOOPS, not lifetime crash count — without the
     # reset a long-lived server would die on its Nth-ever child crash
     stability_window = 60.0
+    last_prune, prune_interval = time.monotonic(), 5.0
     try:
         while stop is None or not stop.is_set():
             # heartbeat + breaker/counter mirror BEFORE blocking on the
@@ -993,12 +1196,20 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                     and time.monotonic() - child_up_since > stability_window):
                 restarts = 0
                 backoff = base_backoff
+            # the engine keeps working between arrivals: with requests
+            # resident or queued it must dispatch the next chunk, not sit in
+            # a 1 s blocking poll
+            busy = controller is not None and scheduler.depth() > 0
+            drain_limit = (max(batch_limit, 4 * executor.slots)
+                           if controller is not None else batch_limit)
             group: typing.List[tuple] = []
             try:
-                group.append(requests.get(timeout=1.0))
+                if not busy:
+                    group.append(requests.get(timeout=1.0))
                 # drain whatever else queued while the last decode ran —
-                # concurrent completions then share ONE decode call
-                while len(group) < batch_limit:
+                # concurrent completions then share ONE decode call (batch)
+                # or co-reside in the slot pool (continuous)
+                while len(group) < drain_limit:
                     try:
                         group.append(requests.get_nowait())
                     except queue_mod.Empty:
@@ -1009,7 +1220,7 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                 # Manager torn down under us (interpreter exit with the loop
                 # in a daemon thread) — stop serving instead of tracebacking
                 break
-            if not group:
+            if not group and not busy:
                 if not proc.is_alive():
                     restarts += 1
                     total_restarts += 1
@@ -1033,16 +1244,36 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                 continue
             try:
                 now = time.monotonic()
-                for old_rid, entry in list(responses.items()):
-                    if now - entry["t"] > prune_horizon:
-                        responses.pop(old_rid, None)
-                # drained-but-decoding requests still occupy the admission
-                # budget: the child adds this to qsize for 429 and /ready
-                state["inflight"] = len(group)
-                # decode errors are answered inside _process_group; only a
-                # Manager teardown mid-respond can raise out of it
-                _process_group(handlers, interface, guard, responses, group)
-                state["inflight"] = 0
+                if now - last_prune > prune_interval:
+                    # throttled: the engine loop turns over once per chunk,
+                    # and a full responses scan is a Manager round-trip per
+                    # entry — per-chunk scans would hammer the IPC process
+                    last_prune = now
+                    for old_rid, entry in list(responses.items()):
+                        if now - entry["t"] > prune_horizon:
+                            responses.pop(old_rid, None)
+                if controller is not None:
+                    new_reqs = _engine_classify(handlers, interface,
+                                                responses, group,
+                                                time.monotonic)
+                    controller.round(new_reqs)
+                    # THE admission-budget fix (docs/SERVING.md): requests
+                    # the loop drained into the engine — queued behind the
+                    # slot pool OR resident in it — still hold budget, so
+                    # the child's 429 and the /ready watermark see them.
+                    # The batch path's len(group) only ever counted the
+                    # current drain.
+                    state["inflight"] = scheduler.depth()
+                else:
+                    # drained-but-decoding requests still occupy the
+                    # admission budget: the child adds this to qsize for
+                    # 429 and /ready
+                    state["inflight"] = len(group)
+                    # decode errors are answered inside _process_group; only
+                    # a Manager teardown mid-respond can raise out of it
+                    _process_group(handlers, interface, guard, responses,
+                                   group)
+                    state["inflight"] = 0
             except (EOFError, BrokenPipeError, ConnectionError, OSError):
                 break
     finally:

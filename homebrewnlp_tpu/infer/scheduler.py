@@ -1,0 +1,294 @@
+"""Host-side scheduling for the continuous-batching engine.
+
+Deliberately device-free (stdlib + numpy only — no jax import anywhere), so
+the scheduler state machine is testable with a fake clock and a fake
+executor (tests/continuous_batching_test.py, marker ``contbatch``):
+
+* :class:`EngineRequest` — one parsed completion riding the engine, with its
+  admission timestamp, deadline, and decode extent.
+* :class:`SlotScheduler` — FIFO pending queue x fixed slot set: admit-order
+  fairness, slot exhaustion queues (never errors), deadline expiry for both
+  queued and resident requests, recycling of finished slots.
+* :class:`EngineController` — one serving round: expire -> (breaker) ->
+  admit -> dispatch -> extract.  The executor is injected
+  (``infer.engine.EngineExecutor`` in production) and must expose
+  ``slots``/``seq``, ``admit(slot, req)``, ``release(slot)``,
+  ``dispatch(steps) -> positions``, ``tokens(slot)``, ``reset()``.
+
+Exactly-one-answer invariant: every submitted request leaves the scheduler
+through exactly one of ``answer(req, outcome)``'s outcomes — ``("ok",
+tokens)``, ``("timeout", where)``, ``("error", exc)``, or ``("unavailable",
+retry_after)`` — mirroring PR 3's batch-path guarantee per slot.
+
+PR 3 mechanics carry over per slot: a deadline-expired RESIDENT is evicted
+at the next chunk boundary (answered 504 by the caller); a failed dispatch
+answers every resident as a decode failure and counts ONE event into the
+breaker; an open breaker sheds the pending queue without a device call and
+half-open admits a single probe request.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+import typing
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One parsed completion request riding the engine."""
+    rid: str
+    path: str
+    toks: typing.Any                      # prompt tokens (1-D int array)
+    temperature: float = 0.0
+    response_len: typing.Optional[int] = None
+    top_k: typing.Optional[int] = None
+    top_p: typing.Optional[float] = None
+    rep_penalty: typing.Optional[float] = None
+    deadline: typing.Optional[float] = None    # monotonic; None = none
+    enqueue_ts: typing.Optional[float] = None  # HTTP-child admission stamp
+    submitted_ts: float = 0.0                  # set by SlotScheduler.submit
+
+    def prompt_len(self, seq: int) -> int:
+        """Prompt tokens the decode keeps (clipped to capacity, matching
+        ``InterfaceWrapper.complete_tokens``)."""
+        return min(len(self.toks), seq - 1)
+
+    def end_pos(self, seq: int) -> int:
+        """The slot's decode extent: prompt + response cap, clipped."""
+        n = self.prompt_len(seq)
+        if self.response_len is None:
+            return seq
+        return min(seq, n + int(self.response_len))
+
+
+class SlotScheduler:
+    """FIFO pending queue over a fixed slot set."""
+
+    def __init__(self, slots: int,
+                 clock: typing.Callable[[], float] = time.monotonic):
+        self.slots = int(slots)
+        self.clock = clock
+        self.pending: typing.Deque[EngineRequest] = collections.deque()
+        #: slot -> (request, admitted_ts)
+        self.resident: typing.Dict[int, typing.Tuple[EngineRequest, float]] \
+            = {}
+        self._free = list(range(self.slots))
+
+    # -- queue side ----------------------------------------------------------
+
+    def submit(self, req: EngineRequest) -> None:
+        """Queue a request.  Slot exhaustion only ever queues — the 429
+        admission budget lives at the HTTP edge (serving_guard), not here."""
+        req.submitted_ts = self.clock()
+        self.pending.append(req)
+
+    def drain_pending(self) -> typing.List[EngineRequest]:
+        """Remove and return every queued request (breaker-open shedding)."""
+        out = list(self.pending)
+        self.pending.clear()
+        return out
+
+    # -- deadlines -----------------------------------------------------------
+
+    def expire(self, now: typing.Optional[float] = None
+               ) -> typing.Tuple[typing.List[EngineRequest],
+                                 typing.List[typing.Tuple[int, EngineRequest]]]:
+        """Remove deadline-expired requests: returns ``(queued, resident)``
+        where resident entries are ``(slot, request)`` and their slots are
+        already recycled — the caller answers each 504 exactly once."""
+        now = self.clock() if now is None else now
+        queued = [r for r in self.pending
+                  if r.deadline is not None and now >= r.deadline]
+        if queued:
+            gone = set(id(r) for r in queued)
+            self.pending = collections.deque(
+                r for r in self.pending if id(r) not in gone)
+        evicted = []
+        for slot, (req, _) in sorted(self.resident.items()):
+            if req.deadline is not None and now >= req.deadline:
+                evicted.append((slot, req))
+        for slot, _ in evicted:
+            del self.resident[slot]
+            self._free.append(slot)
+        return queued, evicted
+
+    # -- slots ---------------------------------------------------------------
+
+    def admit(self, now: typing.Optional[float] = None,
+              limit: typing.Optional[int] = None
+              ) -> typing.List[typing.Tuple[int, EngineRequest, float]]:
+        """Place queued requests into free slots, strictly FIFO.  Returns
+        ``(slot, request, queue_wait_seconds)`` per admission."""
+        now = self.clock() if now is None else now
+        out = []
+        budget = len(self._free) if limit is None else min(limit,
+                                                           len(self._free))
+        while self.pending and budget > 0:
+            req = self.pending.popleft()
+            slot = self._free.pop(0)
+            self.resident[slot] = (req, now)
+            out.append((slot, req, max(0.0, now - req.submitted_ts)))
+            budget -= 1
+        return out
+
+    def finish(self, slot: int, now: typing.Optional[float] = None
+               ) -> typing.Tuple[EngineRequest, float]:
+        """Recycle a finished slot; returns ``(request, residency_s)``."""
+        now = self.clock() if now is None else now
+        req, admitted = self.resident.pop(slot)
+        self._free.append(slot)
+        return req, max(0.0, now - admitted)
+
+    def clear_residents(self) -> typing.List[typing.Tuple[int, EngineRequest]]:
+        """Remove every resident (failed-dispatch recovery); slots free."""
+        out = sorted((slot, req) for slot, (req, _) in self.resident.items())
+        self.resident.clear()
+        self._free = list(range(self.slots))
+        return out
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def depth(self) -> int:
+        """Requests holding admission budget: queued + engine-resident."""
+        return len(self.pending) + len(self.resident)
+
+
+class EngineController:
+    """One serving round of the continuous engine, orchestration only.
+
+    ``answer(req, outcome)`` is the caller's responder; ``hooks(event,
+    **kw)`` (optional) receives ``admitted`` (queue_age=), ``evicted``,
+    ``recycled`` (residency=), ``chunk`` (dt=, steps=, cache_bytes=), and
+    ``first_token`` (reqs=[...]) — ``rest_api`` turns these into the
+    /metrics slot series and the TTFT/ITL histograms.
+    """
+
+    def __init__(self, executor, scheduler: SlotScheduler, guard=None,
+                 clock: typing.Callable[[], float] = time.monotonic,
+                 decode_chunk: int = 64, prefill_chunk: int = 128,
+                 answer: typing.Optional[typing.Callable] = None,
+                 hooks: typing.Optional[typing.Callable] = None):
+        self.executor = executor
+        self.sched = scheduler
+        self.guard = guard
+        self.clock = clock
+        self.decode_chunk = max(1, int(decode_chunk))
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.answer = answer or (lambda req, outcome: None)
+        self.hooks = hooks or (lambda event, **kw: None)
+        #: per-slot first-token-reported flags (TTFT closes exactly once)
+        self._first_done: typing.Dict[int, bool] = {}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _plan_steps(self) -> int:
+        """Per-dispatch iteration budget: ``serve_prefill_chunk_tokens``
+        bounds how far prompt walking runs between scheduling boundaries
+        while any admitted request is still consuming its prompt;
+        ``decode_chunk_tokens`` is the steady-state granularity.  The
+        compiled loop exits early once every live slot reaches its end, so
+        over-budgeting costs nothing."""
+        walk = 0
+        for slot, (req, _) in self.sched.resident.items():
+            remaining = (max(1, req.prompt_len(self.executor.seq)) - 1
+                         - int(self.executor.q[slot]))
+            walk = max(walk, remaining)
+        if walk > 0:
+            return max(1, min(self.prefill_chunk, walk))
+        return self.decode_chunk
+
+    def _fail_residents(self, exc: Exception) -> None:
+        """Failed dispatch: every resident is answered as a decode failure
+        (their in-pool state is gone with the donated carry), ONE event
+        counts into the breaker, and the pool re-initialises next round."""
+        if self.guard is not None:
+            self.guard.record_decode_failure()
+        for slot, req in self.sched.clear_residents():
+            self._first_done.pop(slot, None)
+            self.answer(req, ("error", exc))
+        self.executor.reset()
+
+    # -- one round -----------------------------------------------------------
+
+    def round(self, new_requests: typing.Sequence[EngineRequest] = ()
+              ) -> bool:
+        """Admit/evict + at most one chunk dispatch.  Returns True when a
+        dispatch ran (the caller's idle detection)."""
+        now = self.clock()
+        for req in new_requests:
+            self.sched.submit(req)
+        # deadlines first: an expired resident is evicted at this chunk
+        # boundary — answered 504 exactly once, slot recycled immediately
+        queued, evicted = self.sched.expire(now)
+        for req in queued:
+            self.answer(req, ("timeout", "queue"))
+        for slot, req in evicted:
+            self.executor.release(slot)
+            self._first_done.pop(slot, None)
+            self.hooks("evicted")
+            self.answer(req, ("timeout", "slot"))
+        breaker = self.guard.breaker.tick() if self.guard is not None \
+            else "closed"
+        if breaker == "open":
+            ra = self.guard.breaker.retry_after()
+            for req in self.sched.drain_pending():
+                self.answer(req, ("unavailable", ra))
+            return False
+        # half-open: exactly ONE request probes the device (the PR 3
+        # single-probe rule, per slot) — the rest stay queued, not shed
+        limit = None
+        if breaker == "half_open":
+            limit = max(0, 1 - len(self.sched.resident))
+        for slot, req, waited in self.sched.admit(now, limit=limit):
+            self.executor.admit(slot, req)
+            self._first_done[slot] = False
+            self.hooks("admitted", queue_age=waited)
+        if not self.sched.resident:
+            return False
+        steps = self._plan_steps()
+        q_before = self.executor.q.copy()
+        t0 = self.clock()
+        try:
+            q_after = self.executor.dispatch(steps)
+        except Exception as exc:  # noqa: BLE001 — any device fault
+            self._fail_residents(exc)
+            return True
+        dt = self.clock() - t0
+        if self.guard is not None:
+            self.guard.record_decode_success()
+        advanced = int(max(0, (q_after - q_before).max()))
+        seq = self.executor.seq
+        # tokens generated this chunk: per row, write positions q+1..q' that
+        # lie at/past the prompt boundary (prompt-walking steps don't count)
+        generated = 0
+        for slot, (req, _) in self.sched.resident.items():
+            thr = max(1, req.prompt_len(seq))
+            generated += max(0, int(q_after[slot])
+                             - max(int(q_before[slot]), thr - 1))
+        self.hooks("chunk", dt=dt, steps=advanced, generated=generated,
+                   cache_bytes=getattr(self.executor, "cache_bytes", 0))
+        first, finished = [], []
+        for slot, (req, _) in sorted(self.sched.resident.items()):
+            threshold = max(1, req.prompt_len(seq))
+            if not self._first_done.get(slot) and q_after[slot] >= threshold:
+                self._first_done[slot] = True
+                first.append(req)
+            if q_after[slot] >= req.end_pos(seq) - 1:
+                finished.append(slot)
+        if first:
+            self.hooks("first_token", reqs=first)
+        for slot in finished:
+            tokens = self.executor.tokens(slot)
+            req, residency = self.sched.finish(slot, self.clock())
+            self.executor.release(slot)
+            # a zero-generation request (end at/below its prompt) may never
+            # cross the first-token threshold: close its TTFT at completion
+            # (the stepped loop's flush_first_tokens rule)
+            if not self._first_done.pop(slot, True):
+                self.hooks("first_token", reqs=[req])
+            self.hooks("recycled", residency=residency)
+            self.answer(req, ("ok", tokens))
+        return True

@@ -315,7 +315,11 @@ def child_health(state, queue_depth: int, cfg: typing.Dict[str, typing.Any],
             "breaker_trips": int(state.get("breaker_trips", 0) or 0),
             "child_restarts": int(state.get("child_restarts", 0) or 0),
             "serve_batch_size": int(cfg.get("serve_batch_size", 1)),
-            "decode_path": state.get("decode_path")}
+            "decode_path": state.get("decode_path"),
+            # which serving engine the device loop resolved (continuous
+            # slot-pool vs batch-to-completion) and the pool width —
+            # published once at serve() start, ops surface like decode_path
+            "engine": state.get("engine")}
 
 
 def child_ready(state, queue_depth: int, cfg: typing.Dict[str, typing.Any]

@@ -745,6 +745,13 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
         if axis is None:
             # small recurrence caches: the stacked ys IS the new buffer
             new = arr
+        elif decode_mod.is_vector_pos(state.pos):
+            # per-slot positions (continuous-batching engine): each row of
+            # every depth scatters at its own position — vmap the per-row
+            # scatter over the leading depth axis of the stacked buffer
+            with jax.named_scope("cache_write"):
+                new = jax.vmap(lambda b, r: decode_mod.scatter_rows(
+                    b, r, state.pos, axis))(stacked_caches[rel], arr)
         else:
             # all depth rows land in one scatter at the token position
             starts = [jnp.int32(0)] * arr.ndim

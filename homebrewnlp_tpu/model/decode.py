@@ -36,7 +36,16 @@ from ..core.tensor import NamedTensor, nt
 
 
 class DecodeState:
-    """Carried through one decode step: position + cache pytree in/out."""
+    """Carried through one decode step: position + cache pytree in/out.
+
+    ``pos`` is a scalar in the classic samplers (every batch row sits at the
+    same position) or an int32 VECTOR ``[batch]`` under the continuous-
+    batching engine (infer/engine.py), where co-resident requests decode at
+    independent positions: the cache scatter becomes per-row
+    (:func:`scatter_rows`), causal masks compare keys against each row's own
+    position (model/utils.py ``compare_range``), and position embeddings
+    gather each row's own row (model/embedding.py).  Every vector branch is
+    gated on ``pos.ndim`` so the scalar paths stay byte-identical."""
 
     def __init__(self, pos: jax.Array, seq_len: int, seq_name: str,
                  caches: typing.Dict[str, jax.Array],
@@ -162,6 +171,55 @@ def _constrain_cache(state: DecodeState, buf: jax.Array,
     return with_constraint(nt(buf, list(dims)), state.model_params, mesh).data
 
 
+def is_vector_pos(pos) -> bool:
+    """True for the continuous-batching engine's per-row position vector."""
+    return getattr(pos, "ndim", 0) > 0
+
+
+def scatter_rows(buf: jax.Array, row: jax.Array, pos: jax.Array,
+                 axis: int) -> jax.Array:
+    """Scatter a length-1 slice into ``buf`` at PER-ROW positions.
+
+    ``buf``: ``[batch, ...]`` (batch leading), ``row``: same shape with
+    size 1 at ``axis``, ``pos``: int32 ``[batch]``.  The per-row analogue of
+    ``dynamic_update_slice_in_dim`` — lowers to one HLO scatter, which the
+    aliaser keeps in place under donation exactly like the slice update
+    (the engine's HLO audit pins that).  Out-of-range positions DROP their
+    update (finished slots parked past their end write nothing)."""
+    idx: typing.List[typing.Any] = [slice(None)] * buf.ndim
+    idx[0] = jnp.arange(buf.shape[0])
+    idx[axis] = pos
+    # with batch leading, the gather/scatter value shape is [batch] + the
+    # remaining dims in original order whether or not the two advanced
+    # indices are adjacent — exactly row with its size-1 axis squeezed
+    return buf.at[tuple(idx)].set(jnp.squeeze(row, axis=axis), mode="drop")
+
+
+def _row_write(state: "DecodeState", buf: jax.Array, row: jax.Array,
+               axis: int) -> jax.Array:
+    """One cache-row write at ``state.pos``: slice update for the scalar
+    samplers, per-row scatter for the engine's position vector."""
+    if is_vector_pos(state.pos):
+        return scatter_rows(buf, row, state.pos, axis)
+    return jax.lax.dynamic_update_slice_in_dim(buf, row, state.pos, axis)
+
+
+def _batch_leading(x: NamedTensor, batch: int) -> NamedTensor:
+    """Vector-pos KV tensors need the batch dim leading (scatter_rows
+    contract).  Batch-less tensors (positional key embeddings reaching the
+    cache without riding an activation) broadcast to per-row copies — the
+    scatter POSITION differs per row, so a shared buffer cannot hold them."""
+    if x.dims and x.dims[0].name == "batch":
+        return x
+    if any(d.name == "batch" for d in x.dims):
+        raise NotImplementedError(
+            "per-slot decode needs batch-leading KV tensors, got "
+            f"{[d.name for d in x.dims]}")
+    bdim = Dim("batch", batch)
+    return nt(jnp.broadcast_to(x.data[None], (batch,) + x.data.shape),
+              [bdim] + list(x.dims))
+
+
 def _quantize_int8_rows(data: jax.Array):
     """Per-row symmetric int8 quantization over the trailing feature axis:
     returns (q int8, scale f32 with last axis 1).  The single definition is
@@ -197,6 +255,11 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
     """
     state = active()
     assert state is not None and is_decode_dim(state, dim)
+    if is_vector_pos(state.pos):
+        # per-slot positions: the scatter needs batch leading (and a batch
+        # axis at all — positional key embeddings broadcast to one row per
+        # slot, since each slot scatters at its own position)
+        x = _batch_leading(x, state.pos.shape[0])
     ctx = scope.current()
     name = "cache/" + ctx.full_name("kv")
     axis = x.axis(dim)
@@ -220,12 +283,11 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
         with jax.named_scope("cache_write"):
             q, scale = _quantize_int8_rows(x.data)
             buf = _cache(name, shape, jnp.int8)
-            buf = jax.lax.dynamic_update_slice_in_dim(buf, q, state.pos, axis)
+            buf = _row_write(state, buf, q, axis)
             buf = _constrain_cache(state, buf, full_dims)
             sname = name + "_scale"
             sbuf = _cache(sname, shape[:-1] + [1], jnp.float32)
-            sbuf = jax.lax.dynamic_update_slice_in_dim(sbuf, scale, state.pos,
-                                                       axis)
+            sbuf = _row_write(state, sbuf, scale, axis)
             sbuf = _constrain_cache(state, sbuf,
                                     full_dims[:-1] + [Dim("_kv_scale", 1)])
         state.out[name] = buf
@@ -237,8 +299,7 @@ def spread(x: NamedTensor, dim: Dim) -> NamedTensor:
         return nt(deq, full_dims)
     with jax.named_scope("cache_write"):
         buf = _cache(name, shape, store_dtype)
-        buf = jax.lax.dynamic_update_slice_in_dim(
-            buf, x.data.astype(store_dtype), state.pos, axis)
+        buf = _row_write(state, buf, x.data.astype(store_dtype), axis)
         buf = _constrain_cache(state, buf, full_dims)
     state.out[name] = buf
     state.row_updates[name] = (x.data.astype(store_dtype), axis)

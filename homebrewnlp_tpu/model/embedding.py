@@ -91,6 +91,22 @@ def _embed(args: BlockArgs, shape: SHAPE) -> NamedTensor:
         # slice every full-length stand-in wherever it landed
         data = out.data
         out_dims = list(out.dims)
+        if decode_mod.is_vector_pos(state.pos):
+            # continuous-batching engine: each slot reads ITS OWN row of
+            # the full-length embedding — a per-row gather that adds a
+            # batch dim (broadcast by name downstream).  Text decode has
+            # exactly one sequence stand-in; a second would gather batch
+            # twice, so fail loudly rather than mis-broadcast
+            if len(sliced_axes) != 1:
+                raise NotImplementedError(
+                    "per-slot decode supports one sliced position axis, "
+                    f"got {len(sliced_axes)} in {full_shape}")
+            assert not any(d.name == "batch" for d in out_dims), out_dims
+            i = sliced_axes[0]
+            axis = out_dims.index(full_shape[i])
+            data = jnp.take(data, state.pos[:, None], axis=axis)
+            out_dims[axis:axis + 1] = [params.batch_dim, shape[i]]
+            return nt(data, out_dims)
         for i in sliced_axes:
             axis = out_dims.index(full_shape[i])
             data = jax.lax.dynamic_slice_in_dim(data, state.pos, 1, axis=axis)

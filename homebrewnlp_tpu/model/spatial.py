@@ -209,6 +209,11 @@ def cummean(args: BlockArgs) -> NamedTensor:
     if decode_mod.is_decode_dim(state, dim):
         import jax.numpy as jnp
         from ..core.tensor import nt
+        if decode_mod.is_vector_pos(state.pos):
+            # per-slot positions: each row divides by its own 1 + pos
+            return cumsum(args) / nt(
+                jnp.asarray(1 + state.pos, args.tensor.data.dtype),
+                [args.params.batch_dim])
         return cumsum(args) / nt(jnp.asarray(1 + state.pos,
                                              args.tensor.data.dtype), ())
     return cumsum(args) / (1 + range_(dim, args.tensor.dtype))

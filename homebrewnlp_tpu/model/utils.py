@@ -90,6 +90,14 @@ def compare_range(params: ModelParameter, dim0: Dim, dim1: Dim,
 
     def _range(d: Dim) -> NamedTensor:
         if decode.is_decode_dim(state, d):
+            if decode.is_vector_pos(state.pos):
+                # continuous-batching engine: each slot sits at its own
+                # position, so the query range is per-row — masks gain a
+                # batch dim and broadcast by name downstream
+                assert state.pos.shape[0] == params.batch_dim.size, \
+                    (state.pos.shape, params.batch_dim)
+                return nt(state.pos[:, None].astype(jnp.int32),
+                          [params.batch_dim, d])
             return nt(state.pos[None].astype(jnp.int32), [d])
         return range_(d, jnp.int32)
 

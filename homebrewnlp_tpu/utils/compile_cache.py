@@ -1,0 +1,67 @@
+"""Persistent XLA compilation cache wiring (ROADMAP item 5, first sliver).
+
+Every BENCH round and every serving relaunch pays ~90s setup + ~100s
+compile+warmup before the first useful step.  jax ships a persistent
+compilation cache (``jax_compilation_cache_dir``) that serves an unchanged
+program's compile from disk; this module turns the config knob
+``compile_cache_dir`` into that configuration, applied once per process
+BEFORE the first jit compile (main.py does it for every run mode, the
+serving bench for its spawned servers).
+
+The two threshold knobs are forced permissive: jax's defaults only persist
+compiles slower than ~1s / larger than a floor, which silently skips
+exactly the many-small-programs profile of the stepped decode path (dozens
+of chunk-step variants, each fast to compile but slow in aggregate).
+
+tests/continuous_batching_test.py asserts a second in-process build of the
+same program HITS the cache (entries appear on the first compile, none are
+added by the second after ``jax.clear_caches()``).
+"""
+from __future__ import annotations
+
+import os
+import typing
+
+
+def install_compile_cache(params_or_dir) -> typing.Optional[str]:
+    """Point jax's persistent compilation cache at the configured directory.
+
+    Accepts a ``ModelParameter`` (reads ``compile_cache_dir``) or a path
+    string; returns the installed path, or None when the knob is off.
+    Idempotent — safe to call from every entry point that might run first.
+    """
+    path = getattr(params_or_dir, "compile_cache_dir", params_or_dir)
+    if not path:
+        return None
+    path = os.path.abspath(os.path.expanduser(str(path)))
+    os.makedirs(path, exist_ok=True)
+    import jax
+    # persist EVERYTHING: the default min-compile-time (~1s) skips the
+    # decode chunk steps this exists for
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:  # knob renamed across jax versions — best effort
+        pass
+    jax.config.update("jax_compilation_cache_dir", path)
+    # ALSO reset the cache object: jax initialises it lazily on the first
+    # compile and never re-reads the config after — without the reset, any
+    # earlier jit in the process (warmup, another mode) would leave the
+    # knob silently dead for the rest of the process
+    _reset_cache_object()
+    return path
+
+
+def _reset_cache_object() -> None:
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass
+
+
+def uninstall_compile_cache() -> None:
+    """Turn the persistent cache back off (test isolation)."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_cache_object()

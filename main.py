@@ -42,6 +42,13 @@ def main():
     from homebrewnlp_tpu.utils import retry
 
     params = ModelParameter(config)
+    # persistent XLA compile cache applies to EVERY run mode and must be
+    # configured before the first jit compile: warm restarts (run_manager
+    # relaunches, serving respawns) then skip the compile+warmup tax
+    from homebrewnlp_tpu.utils.compile_cache import install_compile_cache
+    cache_dir = install_compile_cache(params)
+    if cache_dir:
+        print(f"persistent compilation cache: {cache_dir}")
     # storage retry knobs apply to EVERY run mode (serving restores through
     # the same flaky bucket as training; train() re-installs identically)
     retry.set_default_policy(retry.RetryPolicy(

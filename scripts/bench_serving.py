@@ -1,0 +1,391 @@
+#!/usr/bin/env python3
+"""Serving traffic generator: batch-to-completion vs continuous batching.
+
+Drives the REAL REST path — ``rest_api.serve`` with its isolated device
+loop, HTTP child, Manager IPC, admission control — with a reproducible
+mixed-length workload (short and long prompts x short and long responses,
+the regime where batch-to-completion pins a whole co-batch on its longest
+row), in two generator modes per engine:
+
+* **closed loop** — C workers each firing its next request the moment the
+  previous answer lands (saturation throughput), then
+* **open loop** — seeded-exponential interarrivals at a target rate, each
+  request on its own thread (latency under a Poisson-ish load, the number
+  p99 TTFT is about).
+
+Per engine it reports client-side tokens/sec + request outcomes and the
+server-side p50/p99 TTFT + ITL scraped from ``/metrics`` (the engine
+records TTFT per slot event, the batch path per stepped-loop hook — the
+bench config forces ``decode_loop=stepped`` so both sides report), and
+writes a BENCH_*-style row to ``BENCH_SERVING.json``.
+
+Acceptance (ISSUE 7): on the CPU backend the continuous engine sustains
+>= 1.5x the batch engine's closed-loop tokens/sec at mixed lengths with a
+lower open-loop p99 TTFT; the exit code enforces it under ``--check``.
+
+Fault schedules: ``--latency I:SEC[,I:SEC...]`` wraps the interface in
+``utils.fault_injection.FaultyInterface`` (the PR 3 schedules) — decode
+call I sleeps SEC first.  The schedules fire on ``complete_tokens*`` calls,
+i.e. the BATCH engine's decode path (the continuous engine drives the model
+directly); use them to reproduce deadline/429 behavior under a stalling
+batch decode.
+
+CPU-scale model by default (harness-size mixer, seq 64); pass a config
+JSON via ``--config`` to run a real checkpoint's shape instead.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+#: harness-scale serving model: small enough that one decode iteration is
+#: milliseconds on CPU, deep/wide enough that the slot pool is a real
+#: multi-leaf cache pytree (depth-stacked KV + int8-composable layout)
+BENCH_CONFIG = {
+    "model_mode": "gpt", "use_video": False, "use_language": True,
+    "sequence_length": 64, "features_per_head": 16, "heads": 2,
+    "depth": 2, "train_batch_size": 1, "vocab_size": 256,
+    "group_linear_factor": 2,
+    "intermediate_feed_forward_multiplier_multiplier": 0.5,
+    "memory_reduction_strategy": "none",
+    "block_config": [
+        {"layer": ["norm-shift-scale-features-group",
+                   "bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:"
+                   "shift-mid:scale-mid:features"]},
+        {"layer": ["norm-shift-scale-features-group",
+                   "attention-biased_attention_map-absolute-input_as_value-"
+                   "shared"]}],
+    # the stepped loop on BOTH engines: it is what reports TTFT/ITL on the
+    # batch path, and fine chunks are what let the continuous engine
+    # recycle finished slots quickly (chunk boundaries = scheduling points)
+    "decode_loop": "stepped", "decode_chunk_tokens": 4,
+    "serve_prefill_chunk_tokens": 8,
+    "serve_queue_limit": 256, "serve_request_deadline_s": 120.0,
+    "model_path": "/tmp/bench_serving",
+}
+
+#: mixed request classes (prompt_tokens, max_tokens): the short/long mix
+#: that makes batch-to-completion pay head-of-line blocking
+WORKLOAD = ((3, 4), (5, 8), (2, 16), (6, 48), (4, 4), (3, 32))
+
+
+def _build_interface(config_path=None, latency=None):
+    import numpy as np
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.infer.interface import InterfaceWrapper
+    from homebrewnlp_tpu.model import Model
+    import jax.numpy as jnp
+
+    cfg = dict(BENCH_CONFIG)
+    if config_path:
+        with open(config_path) as f:
+            cfg = {**json.load(f), "decode_loop": "stepped"}
+    params = ModelParameter(cfg)
+    params.train = False
+    model = Model(params)
+    seq = params.sequence_dim.size
+    tps = params.token_patch_dim.size
+    zeros = np.zeros((1, seq, tps), np.int32)
+    variables = {k: jnp.asarray(v)
+                 for k, v in model.init({"token_x": zeros,
+                                         "token_y": zeros}).items()}
+    interface = InterfaceWrapper(params, model, variables)
+    if latency:
+        from homebrewnlp_tpu.utils.fault_injection import FaultyInterface
+        interface = FaultyInterface(interface, latency=latency)
+    return interface
+
+
+def _spawn(interface, engine: str, slots: int, batch: int):
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.infer import rest_api
+
+    params = ModelParameter(interface.params,
+                            serve_engine=engine, serve_slots=slots,
+                            serve_batch_size=batch)
+    params.train = False
+    interface.params.serve_engine = engine   # FaultyInterface proxies params
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stop = threading.Event()
+    t = threading.Thread(target=rest_api.serve, args=(params, interface),
+                         kwargs={"port": port, "isolate": True, "stop": stop},
+                         daemon=True)
+    t.start()
+    return port, stop, t
+
+
+def _post(port, payload, timeout=180.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/token_completion",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait_up(port, deadline_s=180.0):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/health")
+    t0 = time.monotonic()
+    while True:
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            if time.monotonic() - t0 > deadline_s:
+                raise
+            time.sleep(0.25)
+
+
+def _scrape_buckets(port):
+    """Cumulative TTFT/ITL bucket counts from the /metrics exposition."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    out = {}
+    for name in ("hbnlp_serve_ttft_seconds", "hbnlp_serve_itl_seconds"):
+        pat = re.compile(rf'^{name}_bucket{{le="([^"]+)"}} (\d+)', re.M)
+        pairs = sorted(
+            (float("inf") if le == "+Inf" else float(le), int(c))
+            for le, c in pat.findall(text))
+        bounds = [b for b, _ in pairs if b != float("inf")]
+        cum = [c for _, c in pairs]
+        out[name] = (bounds,
+                     [c - (cum[i - 1] if i else 0)
+                      for i, c in enumerate(cum)])
+    return out
+
+
+def _quantiles(before, after):
+    """p50/p99 of the TIMED window: per-bucket count delta between two
+    scrapes — the warmup window's compile-dominated TTFTs must not ride
+    the tail of the measured distribution."""
+    from homebrewnlp_tpu.telemetry.registry import histogram_quantile
+    out = {}
+    for name, (bounds, counts_after) in after.items():
+        counts_before = before.get(name, (bounds, [0] * len(counts_after)))[1]
+        counts = [a - b for a, b in zip(counts_after, counts_before)]
+        key = "ttft" if "ttft" in name else "itl"
+        out[f"{key}_count"] = sum(counts)
+        for q in (0.5, 0.99):
+            out[f"{key}_p{int(q * 100)}"] = histogram_quantile(bounds,
+                                                               counts, q)
+    return out
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.errors = {}
+        self.generated = 0
+
+    def record(self, status, body, prompt_len):
+        with self.lock:
+            if status == 200:
+                self.ok += 1
+                self.generated += max(0, len(body.get("tokens", ()))
+                                      - prompt_len)
+            else:
+                key = str(status)
+                self.errors[key] = self.errors.get(key, 0) + 1
+
+
+def _request_for(rng, i):
+    plen, mt = WORKLOAD[i % len(WORKLOAD)]
+    toks = [int(x) for x in rng.integers(1, 255, plen)]
+    return {"tokens": toks, "max_tokens": mt, "temperature": 0.0}, plen
+
+
+def _closed_loop(port, rng, workers: int, per_worker: int):
+    stats = _Stats()
+    # payloads pre-drawn on this thread: numpy Generators are not
+    # thread-safe, and racy draw order would break --seed reproducibility
+    payloads = [[_request_for(rng, w * per_worker + i)
+                 for i in range(per_worker)] for w in range(workers)]
+
+    def worker(w):
+        for payload, plen in payloads[w]:
+            try:
+                status, body = _post(port, payload)
+            except Exception:
+                stats.record(599, {}, plen)
+                continue
+            stats.record(status, body, plen)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    return stats, wall
+
+
+def _open_loop(port, rng, rate_rps: float, duration_s: float):
+    stats = _Stats()
+    threads = []
+    t0 = time.monotonic()
+    i = 0
+    while time.monotonic() - t0 < duration_s:
+        payload, plen = _request_for(rng, i)
+        i += 1
+
+        def fire(payload=payload, plen=plen):
+            try:
+                status, body = _post(port, payload)
+            except Exception:
+                stats.record(599, {}, plen)
+                return
+            stats.record(status, body, plen)
+
+        th = threading.Thread(target=fire, daemon=True)
+        th.start()
+        threads.append(th)
+        time.sleep(float(rng.exponential(1.0 / rate_rps)))
+    for th in threads:
+        th.join(timeout=180)
+    wall = time.monotonic() - t0
+    return stats, wall
+
+
+def run_engine(engine: str, args, latency=None) -> dict:
+    import numpy as np
+    interface = _build_interface(args.config, latency=latency)
+    port, stop, t = _spawn(interface, engine, args.slots, args.batch)
+    try:
+        health = _wait_up(port)
+        assert (health.get("engine") or {}).get("mode") == engine, health
+        # warmup: compile every program shape out of the timed window
+        warm_rng = np.random.default_rng(7)
+        for i in range(max(2, args.slots)):
+            payload, _ = _request_for(warm_rng, i)
+            _post(port, payload)
+        rng = np.random.default_rng(args.seed)
+        # the scrape merges the device loop's snapshot, published once per
+        # loop turn — give it one idle poll to flush the warmup counts
+        time.sleep(1.5)
+        baseline = _scrape_buckets(port)
+        closed, closed_wall = _closed_loop(port, rng, args.concurrency,
+                                           args.requests)
+        open_stats, open_wall = _open_loop(port, rng, args.rate,
+                                           args.duration)
+        time.sleep(1.5)   # final snapshot publish
+        q = _quantiles(baseline, _scrape_buckets(port))
+        row = {
+            "engine": engine,
+            "closed_loop": {
+                "requests_ok": closed.ok, "errors": closed.errors,
+                "generated_tokens": closed.generated,
+                "wall_s": round(closed_wall, 3),
+                "tokens_per_sec": round(closed.generated
+                                        / max(closed_wall, 1e-9), 2),
+            },
+            "open_loop": {
+                "rate_rps": args.rate, "requests_ok": open_stats.ok,
+                "errors": open_stats.errors,
+                "generated_tokens": open_stats.generated,
+                "wall_s": round(open_wall, 3),
+            },
+            **{k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in q.items()},
+        }
+        return row
+    finally:
+        stop.set()
+        t.join(timeout=30)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--engines", default="batch,continuous",
+                    help="comma list: batch, continuous")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="serve_slots for the continuous engine")
+    ap.add_argument("--batch", type=int, default=4,
+                    help="serve_batch_size for the batch engine (kept equal "
+                         "to --slots by default for a fair width match)")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop worker count")
+    ap.add_argument("--requests", type=int, default=6,
+                    help="closed-loop requests per worker")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="open-loop duration (s)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--config", default=None,
+                    help="config JSON instead of the harness-scale model")
+    ap.add_argument("--latency", default=None,
+                    help="FaultyInterface schedule 'I:SEC[,I:SEC...]' — "
+                         "decode call I sleeps SEC (batch-path decode calls)")
+    ap.add_argument("--out", default="BENCH_SERVING.json")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero unless continuous >= 1.5x batch "
+                         "closed-loop tokens/sec AND lower p99 TTFT")
+    args = ap.parse_args(argv)
+    args.batch = args.batch or args.slots
+
+    latency = None
+    if args.latency:
+        latency = {int(k): float(v) for k, v in
+                   (kv.split(":") for kv in args.latency.split(","))}
+
+    rows = []
+    for engine in args.engines.split(","):
+        engine = engine.strip()
+        row = run_engine(engine, args, latency=latency)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    result = {
+        "metric": "serving tokens/sec + TTFT/ITL @ mixed-length REST "
+                  "traffic (closed+open loop)",
+        "workload": list(WORKLOAD),
+        "slots": args.slots, "batch": args.batch,
+        "concurrency": args.concurrency, "rate_rps": args.rate,
+        "backend": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+        else "default",
+        "rows": rows,
+    }
+    by = {r["engine"]: r for r in rows}
+    if "batch" in by and "continuous" in by:
+        b = by["batch"]["closed_loop"]["tokens_per_sec"]
+        c = by["continuous"]["closed_loop"]["tokens_per_sec"]
+        result["tokens_per_sec_speedup"] = round(c / max(b, 1e-9), 3)
+        bt, ct = by["batch"].get("ttft_p99"), by["continuous"].get("ttft_p99")
+        result["ttft_p99_batch"] = bt
+        result["ttft_p99_continuous"] = ct
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({k: v for k, v in result.items() if k != "rows"}),
+          flush=True)
+    if args.check and "tokens_per_sec_speedup" in result:
+        bt, ct = result["ttft_p99_batch"], result["ttft_p99_continuous"]
+        # an absent quantile means the timed window recorded no TTFT
+        # samples — no latency evidence either way, so the gate FAILS
+        # loudly instead of passing vacuously
+        ok = (result["tokens_per_sec_speedup"] >= 1.5
+              and bt is not None and ct is not None and ct <= bt)
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
