@@ -16,21 +16,30 @@ mechanically for every jitted entry point instead of one-off per PR:
   Stdlib-only and importable standalone (scripts/check_config_docs.py
   loads it without the package).
 - ``entry_points`` — builds a small audit model on the current backend and
-  lowers the four jitted entry points (train step, decode chunk step,
-  prefill-entry step, eval fn) for the HLO passes.
+  lowers the registered jitted entry points (train step, decode chunk
+  step, prefill-entry step, eval fn, engine chunk step) for the HLO
+  passes.
+- ``mesh_audit``  — lowers the entry points under every parallel strategy
+  (dp x tp, ring SP, MoE EP, the pipeline schedules) on 8 virtual CPU
+  devices and audits per-mesh collective budgets, sharding contracts,
+  and peak-HBM liveness against the ``meshes`` section of
+  ``budgets.json``.
+- ``cost_ledger`` — per-entry, per-scope analytical flops/bytes ledger
+  regression-checked against ``cost_ledger.json``.
 
 Run everything: ``python scripts/graft_lint.py --all`` (docs/STATIC_ANALYSIS.md).
 """
 from . import ast_lint, hlo_lint  # noqa: F401
 
-__all__ = ["ast_lint", "hlo_lint", "entry_points"]
+__all__ = ["ast_lint", "hlo_lint", "entry_points", "mesh_audit",
+           "cost_ledger"]
 
 
 def __getattr__(name):
     # entry_points imports model/train/infer machinery (and, inside its
     # functions, jax); load it lazily so `import homebrewnlp_tpu.analysis`
     # stays cheap for AST-only consumers
-    if name == "entry_points":
+    if name in ("entry_points", "mesh_audit", "cost_ledger"):
         import importlib
-        return importlib.import_module(".entry_points", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

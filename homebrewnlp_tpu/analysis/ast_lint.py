@@ -19,6 +19,14 @@ donated-jit     every ``jax.jit(..., donate_argnums=...)`` site must be
                 registered in ``DONATED_JIT_REGISTRY`` so the HLO donation
                 audit (analysis/hlo_lint.py) covers it — an unregistered
                 donation is an unaudited 2x-HBM failure mode.
+mesh-axis-literal  hardcoded mesh-axis name strings ("data", "model",
+                "sequence", "pipe") in axis-consuming positions —
+                PartitionSpec/NamedSharding arguments, ``mesh.shape``
+                subscripts/gets, ``axis_names`` membership tests — outside
+                the axis-defining layers (``parallel/``,
+                ``core/sharding.py``, ``config.py``).  Use the
+                ``core.sharding`` constants (``DATA_AXIS`` ...) so an axis
+                rename cannot silently strand a PartitionSpec.
 config-docs     every ModelParameter knob has a docs/CONFIG.md table row
                 (absorbed from scripts/check_config_docs.py, which now
                 shims onto this rule).
@@ -69,6 +77,25 @@ DONATED_JIT_REGISTRY: typing.Dict[str, str] = {
 }
 
 
+#: mesh-axis names the mesh-axis-literal rule polices (mirrors
+#: core/sharding.py MESH_AXES — mirrored, not imported: this module must
+#: stay importable without jax; tests pin the two in sync)
+MESH_AXIS_NAMES = frozenset(("data", "pipe", "model", "sequence"))
+
+#: files/dirs allowed to spell axis names literally: the axis-DEFINING
+#: layers.  ``config.py`` derives ``mesh_shape``/``layout`` from knobs and
+#: cannot import core.sharding (import cycle), so it stays a defining
+#: layer alongside shardlib and the manual-collective kernels
+MESH_AXIS_ALLOWED = ("homebrewnlp_tpu/parallel/",
+                     "homebrewnlp_tpu/core/sharding.py",
+                     "homebrewnlp_tpu/config.py")
+
+#: callee basenames whose string arguments are axis names
+_AXIS_CALLEES = ("PartitionSpec", "NamedSharding", "P",
+                 "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+                 "all_gather", "psum_scatter", "axis_index", "all_to_all")
+
+
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One violation: ``rule``, ``entry`` (``relpath:line``), ``message``."""
@@ -106,6 +133,9 @@ class _FileVisitor(ast.NodeVisitor):
         self.lines = lines
         self.fn_stack: typing.List[str] = []
         self.findings: typing.List[Finding] = []
+        self.axis_exempt = any(
+            rel == allow or (allow.endswith("/") and rel.startswith(allow))
+            for allow in MESH_AXIS_ALLOWED)
         #: names bound to the time MODULE (``import time [as t]``) and to
         #: the time.time FUNCTION (``from time import time [as now]``) —
         #: the wallclock rule must catch every spelling, not just
@@ -143,8 +173,44 @@ class _FileVisitor(ast.NodeVisitor):
         return ((attr == "time" and mod in self.time_modules)
                 or (not mod and name in self.time_funcs))
 
+    # -- mesh-axis-literal ---------------------------------------------------
+
+    def _axis_literal(self, node: ast.AST, context: str):
+        """Flag every mesh-axis-name string constant in ``node``'s subtree
+        (axis-consuming position established by the caller)."""
+        if self.axis_exempt:
+            return
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                    and sub.value in MESH_AXIS_NAMES):
+                self._add("mesh-axis-literal", sub,
+                          f'hardcoded mesh axis "{sub.value}" in {context} — '
+                          "an axis rename silently strands this site; use "
+                          "the core.sharding constants (DATA_AXIS, "
+                          "MODEL_AXIS, SEQUENCE_AXIS, PIPE_AXIS) or mark "
+                          "the line `graft-lint: allow[mesh-axis-literal]`")
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if "mesh" in _dotted(node.value).lower():
+            self._axis_literal(node.slice, "a mesh-shape subscript")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            others = " ".join(_dotted(c) for c in node.comparators)
+            if "axis_names" in others or "mesh_shape" in others \
+                    or "mesh" in others.lower():
+                self._axis_literal(node.left, "an axis-membership test")
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call):
         name = _dotted(node.func)
+        base = name.split(".")[-1]
+        if base in _AXIS_CALLEES:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                self._axis_literal(arg, f"a {base}(...) argument")
+        elif base == "get" and "mesh" in name.lower() and node.args:
+            self._axis_literal(node.args[0], "a mesh-shape .get() key")
         if self._is_wallclock(name):
             self._add("wallclock", node,
                       "time.time() is wall clock — an NTP step corrupts "

@@ -284,6 +284,131 @@ def collective_census(hlo_text: str) -> typing.Dict[str, int]:
     return census
 
 
+#: one instruction line carrying a collective: the full result segment
+#: (between '=' and the op name) is captured for byte accounting
+_COLLECTIVE_LINE_RE = re.compile(
+    r"=\s*([^=]*?)\s(" + "|".join(COLLECTIVE_OPS) + r")(-start|-done)?\(")
+
+_SHAPE_TOKEN_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|\[[0-9,]+\]<=\[[0-9,]+\]"
+    r"(?:T\([0-9,]+\))?)")
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}")
+
+
+def _parse_replica_groups(spec: str) -> typing.List[typing.List[int]]:
+    """Both HLO spellings -> explicit groups.
+
+    ``{{0,2},{1,3}}`` (explicit) and the iota form ``[2,4]<=[8]`` /
+    ``[2,4]<=[4,2]T(1,0)`` (groups = transpose(reshape(arange(N), dims),
+    perm).reshape(G, S))."""
+    if spec.startswith("{"):
+        return [[int(x) for x in grp.split(",") if x.strip() != ""]
+                for grp in re.findall(r"\{([0-9,\s]*)\}", spec) if grp.strip()]
+    m = re.match(r"\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?", spec)
+    if m is None:
+        return []
+    gshape = [int(x) for x in m.group(1).split(",")]
+    rdims = [int(x) for x in m.group(2).split(",")]
+    ids = np.arange(int(np.prod(rdims))).reshape(rdims)
+    if m.group(3):
+        ids = ids.transpose([int(x) for x in m.group(3).split(",")])
+    return ids.reshape(gshape).tolist()
+
+
+def group_axes(groups: typing.Sequence[typing.Sequence[int]],
+               mesh_shape: typing.Mapping[str, int]) -> typing.Tuple[str, ...]:
+    """Which mesh axes a replica-group set communicates over.
+
+    Device/partition ids are positions in the mesh's device array flattened
+    in axis order (how jax assigns logical ids), so ``unravel_index`` maps
+    each member to mesh coordinates; an axis the members DIFFER on is an
+    axis the collective moves data across.  ``mesh_shape`` must be the
+    ordered axis -> size mapping of the audited mesh."""
+    axes = list(mesh_shape)
+    sizes = [mesh_shape[a] for a in axes]
+    varying: typing.Set[str] = set()
+    for grp in groups:
+        if len(grp) < 2:
+            continue
+        coords = np.asarray([np.unravel_index(i, sizes) for i in grp])
+        for k, a in enumerate(axes):
+            if len(set(coords[:, k].tolist())) > 1:
+                varying.add(a)
+    return tuple(a for a in axes if a in varying)
+
+
+def _pairs_axes(pairs_text: str, mesh_shape: typing.Mapping[str, int]
+                ) -> typing.Tuple[str, ...]:
+    """Axes of a ``source_target_pairs`` permute (each pair one group)."""
+    pairs = re.findall(r"\{?\s*(\d+)\s*,\s*(\d+)\s*\}?", pairs_text)
+    return group_axes([[int(a), int(b)] for a, b in pairs], mesh_shape)
+
+
+def _result_bytes(result_segment: str, async_start: bool) -> int:
+    """Bytes of a collective's result shapes.
+
+    Sync ops: sum every array in the (possibly tuple) result — variadic
+    all-reduces list one shape per operand.  Async ``-start`` tuples
+    interleave operand and result aliases ``(in..., out..., ctx)``; summing
+    would double-count, so take the LARGEST array (equals the shape for
+    all-reduce, the gathered output for all-gather)."""
+    sizes = [int(np.prod([int(d) for d in dims.split(",") if d]))
+             * _DTYPE_BYTES.get(dt, 1)
+             for dt, dims in _SHAPE_TOKEN_RE.findall(result_segment)]
+    if not sizes:
+        return 0
+    return max(sizes) if async_start else sum(sizes)
+
+
+def collective_inventory(hlo_text: str,
+                         mesh_shape: typing.Optional[
+                             typing.Mapping[str, int]] = None
+                         ) -> typing.Dict[str, dict]:
+    """Per-kind ``{"count", "bytes"[, "axes"]}`` census of one compiled
+    module — the ONE census shared by ``scripts/pod_lowering.py`` reports,
+    the dryrun MULTICHIP rows, and the mesh-budget audit, so they can never
+    disagree on a count.  Counting conventions match
+    :func:`collective_census` exactly (sync once, async pairs once via the
+    ``-start`` twin; ``-done`` ignored).
+
+    ``bytes``: result-shape bytes per :func:`_result_bytes` — a consistent
+    *metric*, not a wire model (an all-gather's result counts the gathered
+    array once; per-link traffic differs per algorithm).
+
+    With ``mesh_shape`` (ordered axis -> size of the audited mesh) each
+    kind also carries ``"axes"``: counts keyed by the ``+``-joined mesh
+    axes its replica groups / permute pairs span — the attribution that
+    lets a budget failure NAME the axis a surplus collective reshards
+    over."""
+    inv: typing.Dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_LINE_RE.search(line)
+        if m is None:
+            continue
+        result_seg, kind, suffix = m.groups()
+        if suffix == "-done":
+            continue
+        entry = inv.setdefault(kind, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += _result_bytes(result_seg, suffix == "-start")
+        if mesh_shape is None:
+            continue
+        axes: typing.Tuple[str, ...] = ()
+        g = _REPLICA_GROUPS_RE.search(line)
+        if g is not None:
+            axes = group_axes(_parse_replica_groups(g.group(1)), mesh_shape)
+        else:
+            p = _SOURCE_TARGET_RE.search(line)
+            if p is not None:
+                axes = _pairs_axes(p.group(1), mesh_shape)
+        key = "+".join(axes) if axes else "none"
+        per_axes = entry.setdefault("axes", {})
+        per_axes[key] = per_axes.get(key, 0) + 1
+    return inv
+
+
 def collective_budget_audit(entry: str,
                             census: typing.Mapping[str, int],
                             budget: typing.Mapping[str, int]

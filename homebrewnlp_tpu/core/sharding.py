@@ -24,6 +24,18 @@ from ..config import ModelParameter
 from .dims import Dim
 from .tensor import NamedTensor, nt
 
+#: canonical mesh-axis names.  Code OUTSIDE this module / ``parallel/`` /
+#: ``config.py`` must reference axes through these constants — the
+#: ``mesh-axis-literal`` AST rule (analysis/ast_lint.py) flags hardcoded
+#: axis-name strings so an axis rename cannot silently strand a
+#: PartitionSpec or a ``mesh.shape.get("...")`` probe.
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+#: mesh construction order (build_mesh below)
+MESH_AXES = (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQUENCE_AXIS)
+
 
 def build_mesh(params: ModelParameter,
                devices: typing.Optional[typing.Sequence[jax.Device]] = None) -> Mesh:

@@ -709,8 +709,10 @@ def sample_text(model: Model, variables, prompt_tokens, initial_pos=None,
     tokens_in = jnp.asarray(token_x)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
-        data = mesh.shape.get("data", 1)
-        spec = (PartitionSpec("data") if batch % data == 0 and data > 1
+        from ..core import sharding as shardlib
+        data = mesh.shape.get(shardlib.DATA_AXIS, 1)
+        spec = (PartitionSpec(shardlib.DATA_AXIS)
+                if batch % data == 0 and data > 1
                 else PartitionSpec())
         tokens_in = jax.device_put(tokens_in, NamedSharding(mesh, spec))
     if use_cache and not params.use_video:

@@ -323,7 +323,9 @@ class Model:
         assert not (p.contrastive_across_samples
                     or p.contrastive_across_token_embeddings), \
             "1f1b pipeline supports the plain xent loss only"
-        n_micro = max(1, int(p.pipeline_microbatches or mesh.shape["pipe"]))
+        from ..core import sharding as shardlib
+        n_micro = max(1, int(p.pipeline_microbatches
+                             or mesh.shape[shardlib.PIPE_AXIS]))
         if p.train_batch_size % n_micro:
             raise ValueError(f"batch {p.train_batch_size} not divisible by "
                              f"pipeline_microbatches={n_micro}")
@@ -466,7 +468,9 @@ class Model:
         p = self.params
         assert not p.use_video and p.use_language, \
             "prefill supports text (gpt) mode only"
-        if mesh is not None and getattr(mesh, "shape", {}).get("sequence", 1) > 1:
+        from ..core import sharding as shardlib
+        if mesh is not None \
+                and getattr(mesh, "shape", {}).get(shardlib.SEQUENCE_AXIS, 1) > 1:
             raise ValueError("prefill needs the serving mesh (sequence axis "
                              "folded into data); got a sequence-sharded mesh")
         state = PrefillState(jnp.asarray(n, jnp.int32), p.sequence_dim.size,

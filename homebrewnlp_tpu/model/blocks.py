@@ -896,7 +896,8 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         return forward_only(), plan
 
     mesh = ctx.mesh
-    if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+    from ..core import sharding as shardlib
+    if mesh is not None and mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1:
         from ..parallel.pipeline import pipeline_body
         return pipeline_body(params, mesh, fns, subsets, plan, src,
                              strategy), plan

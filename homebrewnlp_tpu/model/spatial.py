@@ -14,6 +14,7 @@ import typing
 
 from ..config import BlockArgs
 from ..core.dims import Dim, shape_sub
+from ..core import sharding as shardlib
 from ..core.tensor import (NamedTensor, cumsum as tensor_cumsum, einsum, exp,
                            less, multiply, range_, reduce_max, reduce_sum,
                            stop_gradient, greater_equal)
@@ -100,8 +101,10 @@ def _maybe_ring_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
     mesh = ctx.mesh
     if ctx.decode is not None:
         return None
-    if (mesh is None or "sequence" not in getattr(mesh, "axis_names", ())
-            or mesh.shape["sequence"] <= 1 or dim.name != "sequence"):
+    if (mesh is None
+            or shardlib.SEQUENCE_AXIS not in getattr(mesh, "axis_names", ())
+            or mesh.shape[shardlib.SEQUENCE_AXIS] <= 1
+            or dim.name != "sequence"):
         return None
     qkv = _plain_softmax_qkv(args, dim, qry, key, base)
     if qkv is None:
@@ -141,8 +144,8 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
         return None
     if not args.params.use_flash_attention:
         return None
-    if mesh is not None and (mesh.shape.get("sequence", 1) > 1
-                             or mesh.shape.get("pipe", 1) > 1):
+    if mesh is not None and (mesh.shape.get(shardlib.SEQUENCE_AXIS, 1) > 1
+                             or mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1):
         return None
     if mesh is not None:
         # shard-divisibility gate BEFORE extracting qkv: _plain_softmax_qkv
@@ -154,8 +157,9 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
         for d in args.tensor.dims:
             if d not in (dim, args.params.head_dim, args.params.key_dim):
                 lead *= d.size
-        if (lead % max(1, mesh.shape.get("data", 1))
-                or args.params.head_dim.size % max(1, mesh.shape.get("model", 1))):
+        if (lead % max(1, mesh.shape.get(shardlib.DATA_AXIS, 1))
+                or args.params.head_dim.size
+                % max(1, mesh.shape.get(shardlib.MODEL_AXIS, 1))):
             return None
     qkv = _plain_softmax_qkv(args, dim, qry, key, base)
     if qkv is None:
@@ -171,11 +175,14 @@ def _maybe_flash_attention(args: BlockArgs, dim: Dim, qry: NamedTensor,
         out = flash(q, k, v, scale=1.0, causal=True,
                     stash=getattr(ctx, "attn_stash", None))
     else:
-        import jax
         from jax.sharding import PartitionSpec as P
-        spec = P("data" if "data" in mesh.axis_names else None, None,
-                 "model" if "model" in mesh.axis_names else None, None)
-        out = jax.shard_map(
+
+        from ..parallel.compat import shard_map
+        spec = P(shardlib.DATA_AXIS if shardlib.DATA_AXIS in mesh.axis_names
+                 else None, None,
+                 shardlib.MODEL_AXIS if shardlib.MODEL_AXIS in mesh.axis_names
+                 else None, None)
+        out = shard_map(
             lambda q_, k_, v_: flash(q_, k_, v_, scale=1.0, causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.dims import Dim
 from ..core.tensor import NamedTensor, nt
+from .compat import shard_map
 
 AXIS = "pipe"
 
@@ -196,9 +197,9 @@ def pipeline_body(params, mesh: Mesh, fns, subsets, plan, src: NamedTensor,
         return jax.lax.psum(outputs, AXIS)
 
     param_specs = jax.tree.map(lambda _: P(AXIS), stacked)
-    fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(param_specs, P()), out_specs=P(),
-                       axis_names={AXIS}, check_vma=False)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(param_specs, P()), out_specs=P(),
+                   axis_names={AXIS}, check_vma=False)
     # ReplayBlock pins inter-block activation layouts via the scope context's
     # mesh; inside the pipe-manual shard_map those constraints would name
     # manual axes, so blank the mesh while the body traces (GSPMD still

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.dims import Dim
 from ..core.tensor import NamedTensor, nt
 from .pipeline import AXIS, _stack_stages, _stage_layout
+from .compat import shard_map
 
 # kinds, mbs, chunks: [ticks, S] int32 tables
 Schedule = typing.Tuple[np.ndarray, np.ndarray, np.ndarray]
@@ -492,7 +493,7 @@ def pipeline_train_1f1b(params, mesh: Mesh, fns, subsets, plan,
         + ([(0, n_stages - 1)] if n_virtual > 1 else [])
     param_specs = jax.tree.map(lambda _: P(None, AXIS), stacked)
     head_specs = jax.tree.map(lambda _: P(), head_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, head_specs, P(), P()),
         out_specs=(param_specs, head_specs, P(), P(), P()),

@@ -58,6 +58,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -671,9 +673,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                 # same spec, so the locals round-trip bit-exactly)
                 return _from_zigzag(out, axis_name, n_shards), lse
 
-            fn = jax.shard_map(zz_collect, mesh=mesh,
-                               in_specs=(spec, spec, spec),
-                               out_specs=(spec, lse_spec), check_vma=False)
+            fn = shard_map(zz_collect, mesh=mesh,
+                           in_specs=(spec, spec, spec),
+                           out_specs=(spec, lse_spec), check_vma=False)
             with jax.named_scope("ring_attention"):
                 out, lse = fn(q, k, v)
             stash_push(stash, (out, lse))
@@ -689,9 +691,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                                    use_pallas, qz, kz, vz, oz, lse_l)
                 return _from_zigzag(res, axis_name, n_shards)
 
-            fn = jax.shard_map(zz_provide, mesh=mesh,
-                               in_specs=(spec, spec, spec, spec, lse_spec),
-                               out_specs=spec, check_vma=False)
+            fn = shard_map(zz_provide, mesh=mesh,
+                           in_specs=(spec, spec, spec, spec, lse_spec),
+                           out_specs=spec, check_vma=False)
             with jax.named_scope("ring_attention"):
                 return fn(q, k, v, out_s, lse_s)
 
@@ -701,11 +703,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                            qz, kz, vz)
             return _from_zigzag(out, axis_name, n_shards)
 
-        fn = jax.shard_map(zz_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec, check_vma=False)
+        fn = shard_map(zz_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
         with jax.named_scope("ring_attention"):
             return fn(q, k, v)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_core, axis_name, n_shards, causal, scale,
                           block_q),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

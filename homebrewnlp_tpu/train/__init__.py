@@ -116,7 +116,8 @@ class Trainer:
     def _grads(self, variables: Params, batch, rng):
         p = self.params
 
-        if (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1
+        if (self.mesh is not None
+                and self.mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1
                 and p.pipeline_schedule == "1f1b"):
             reason = self._1f1b_exclusion()
             if reason is None:

@@ -49,7 +49,7 @@ def main():
                 "tpu_size": 8})
     params = ModelParameter(cfg)
     assert params.sequence_length == 32768
-    assert params.mesh_shape.get("sequence") == 8
+    assert params.mesh_shape.get(shardlib.SEQUENCE_AXIS) == 8
     mesh = shardlib.build_mesh(params)
     print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())}")
 
@@ -63,7 +63,7 @@ def main():
     state = trainer.init_state(batch)
     n_params = sum(int(np.prod(v.shape)) for v in state.variables.values())
     print(f"params: {n_params:,}  seq={params.sequence_length} "
-          f"sp={params.mesh_shape['sequence']}")
+          f"sp={params.mesh_shape[shardlib.SEQUENCE_AXIS]}")
 
     losses = []
     for i in range(args.steps):

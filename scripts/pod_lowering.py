@@ -23,10 +23,8 @@ Prints one JSON report per target; non-zero exit if any target exceeds HBM.
 from __future__ import annotations
 
 import argparse
-import collections
 import json
 import os
-import re
 import sys
 import time
 import typing
@@ -46,88 +44,14 @@ STANDARD_TARGETS = [
 ]
 
 
-def _patch_cheap_init():
-    """Replace the numpy QR/normal initializers with zeros for the lowering:
-    AOT compilation consumes only shapes/dtypes/shardings, and the QR
-    orthogonalisation of d8192 matrices costs minutes of host time that
-    buys nothing here.  Returns an undo function."""
-    from homebrewnlp_tpu.model import backend
-
-    saved = (backend.OrthogonalInit.__call__, backend.NormalInit.__call__)
-
-    def zeros_orth(self, rng, sizes):
-        import numpy as np
-        return np.zeros(sizes, np.float32)
-
-    def zeros_normal(self, rng, sizes):
-        import numpy as np
-        return np.zeros(sizes, np.float32)
-
-    backend.OrthogonalInit.__call__ = zeros_orth
-    backend.NormalInit.__call__ = zeros_normal
-
-    def undo():
-        backend.OrthogonalInit.__call__, backend.NormalInit.__call__ = saved
-
-    return undo
-
-
-def _opt_state_avals(optimizer, var_avals, mesh):
-    """Optimizer slot avals via the REAL ``Optimizer.init`` slot discovery,
-    with materialisation swapped for ShapeDtypeStructs (``_zeros_for``'s
-    sharding rule: same-shape slots inherit the variable's sharding,
-    reduced-shape slots replicate)."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec
-    from homebrewnlp_tpu import optim as optim_mod
-
-    saved = optim_mod._zeros_for
-
-    def aval_zeros(variable, shape, dtype):
-        sharding = getattr(variable, "sharding", None)
-        if sharding is None or tuple(shape) != tuple(variable.shape):
-            sharding = NamedSharding(mesh, PartitionSpec())
-        return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
-
-    optim_mod._zeros_for = aval_zeros
-    try:
-        return optimizer.init(var_avals)
-    finally:
-        optim_mod._zeros_for = saved
-
-
-def _collective_inventory(hlo: str) -> typing.Dict[str, dict]:
-    """Count + size every cross-partition collective in the compiled HLO."""
-    dtype_bytes = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
-                   "f64": 8, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
-    inv: typing.Dict[str, dict] = collections.defaultdict(
-        lambda: {"count": 0, "bytes_moved": 0})
-    pat = re.compile(
-        r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
-        r"all-to-all)(?:-start)?\b")
-    shape_pat = re.compile(r"(\w+)\[([\d,]*)\]")
-    for line in hlo.splitlines():
-        if "-done" in line:  # paired with the -start op; count once
-            continue
-        m = pat.search(line)
-        if not m or "=" not in line:
-            continue
-        kind = m.group(1)
-        # the result shape follows '=': `%x = bf16[16,4096]{...} all-reduce(...)`
-        # (tuple-shaped async starts list several arrays; sum them all)
-        rhs = line.split("=", 1)[1]
-        rhs = rhs.split(kind)[0]  # shapes before the op name = result shapes
-        nbytes = 0
-        for sm in shape_pat.finditer(rhs):
-            dt, dims = sm.group(1), sm.group(2)
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            nbytes += n * dtype_bytes.get(dt, 4)
-        inv[kind]["count"] += 1
-        inv[kind]["bytes_moved"] += nbytes
-    return dict(inv)
+def _collective_inventory(hlo: str, mesh_shape=None) -> typing.Dict[str, dict]:
+    """Thin shim onto the ONE shared census (analysis/hlo_lint.py
+    ``collective_inventory``): async start/done pairs counted once, the
+    same spelling fallbacks, result-bytes accounting — the dryrun report
+    and the lint layer can no longer disagree on a count.  ``mesh_shape``
+    adds per-mesh-axis attribution to each kind."""
+    from homebrewnlp_tpu.analysis import hlo_lint
+    return hlo_lint.collective_inventory(hlo, mesh_shape)
 
 
 def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
@@ -135,15 +59,12 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
                  keep_hlo_lines: int = 0) -> dict:
     """AOT-compile ``config_path``'s training step for ``topology``; return
     the memory/collective report (raises if compilation itself fails)."""
-    import numpy as np
-    import jax
     from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     from homebrewnlp_tpu.config import ModelParameter
     from homebrewnlp_tpu.core import sharding as shardlib
     from homebrewnlp_tpu.model import Model
-    from homebrewnlp_tpu.train import Trainer, TrainState
+    from homebrewnlp_tpu.train import Trainer
 
     t0 = time.monotonic()
     td = topologies.get_topology_desc(platform="tpu", topology_name=topology)
@@ -171,45 +92,17 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
     cap_key = "HBNLP_FUSED_DQP_CAP_GB"
 
     def _lower_with_cap():
-        seq = params.sequence_length // params.token_patch_size
-        batch_np = {
-            "token_x": np.zeros((params.train_batch_size, seq,
-                                 params.token_patch_size), np.int32),
-            "token_y": np.zeros((params.train_batch_size, seq,
-                                 params.token_patch_size), np.int32)}
+        # ONE aval-construction + lowering path shared with the mesh audit
+        # (analysis/mesh_audit.py train_step_avals): cheap zero-init for the
+        # QR matrices, layout-derived NamedShardings for params, the REAL
+        # Optimizer.init slot discovery for opt-state avals, batch over
+        # 'data' where divisible
+        from homebrewnlp_tpu.analysis import mesh_audit
 
-        undo = _patch_cheap_init()
-        try:
-            variables = model.init(batch_np)
-        finally:
-            undo()
-        trainer.optimizer = __import__(
-            "homebrewnlp_tpu.optim", fromlist=["Optimizer"]).Optimizer(
-                params, model.param_dims)
-
-        var_avals = {
-            k: jax.ShapeDtypeStruct(
-                np.shape(v), np.asarray(v).dtype,
-                sharding=shardlib.named_sharding(
-                    params, model.param_dims.get(k, ()), mesh))
-            for k, v in variables.items()}
-        n_params = sum(int(np.prod(a.shape)) for a in var_avals.values())
-        del variables  # free the host zeros before compiling
-
-        opt_avals = _opt_state_avals(trainer.optimizer, var_avals, mesh)
-        repl = NamedSharding(mesh, PartitionSpec())
-        state_avals = TrainState(
-            var_avals, opt_avals,
-            jax.ShapeDtypeStruct((), np.int32, sharding=repl))
-
-        batch_entries = [None] * 3
-        if params.train_batch_size % mesh.shape.get("data", 1) == 0:
-            batch_entries[0] = "data"
-        batch_sharding = NamedSharding(mesh, PartitionSpec(*batch_entries))
-        batch_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
-                                               sharding=batch_sharding)
-                       for k, v in batch_np.items()}
-        rng_aval = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
+        state_avals, batch_avals, rng_aval, info = mesh_audit.train_step_avals(
+            params, model, mesh, cheap_init=True)
+        n_params = info["n_params"]
+        trainer.optimizer = info["optimizer"]
 
         step_fn = trainer._build_step()
         t_trace = time.monotonic()
@@ -220,7 +113,7 @@ def lower_target(config_path: str, topology: str, hbm_key: str = "v5p",
 
         ma = compiled.memory_analysis()
         hlo = compiled.as_text()
-        inventory = _collective_inventory(hlo)
+        inventory = _collective_inventory(hlo, dict(mesh.shape))
 
         hbm = HBM_BYTES[hbm_key]
         # donated state aliases the output, so peak live ≈ arguments (params +

@@ -25,13 +25,23 @@ from backend import make_params  # noqa: F401  (CPU mesh env bootstrap)
 
 
 def _topologies_available() -> bool:
+    """Probe in a SUBPROCESS with a hard timeout: ``get_topology_desc``
+    does not reliably raise when the TPU plugin is absent — with a stale
+    tunnel env it can block on plugin discovery indefinitely, and this
+    probe runs at collection time, which must never hang the whole
+    suite."""
+    import subprocess
+    import sys
+
     os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5p-8")
+    code = ("from jax.experimental import topologies; "
+            "topologies.get_topology_desc(platform='tpu', "
+            "topology_name='v5p:2x2x1')")
     try:
-        from jax.experimental import topologies
-        topologies.get_topology_desc(platform="tpu",
-                                     topology_name="v5p:2x2x1")
-        return True
-    except Exception:
+        return subprocess.run(
+            [sys.executable, "-c", code], timeout=60,
+            capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
         return False
 
 

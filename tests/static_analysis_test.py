@@ -48,8 +48,12 @@ def ast_rules_repo_clean_test():
 
 
 def budgets_cover_every_entry_point_test():
+    """EXACTLY the registered entry points — an orphan row (entry renamed
+    or dropped) would silently audit nothing, so it fails here and in
+    ``mesh_audit.budget_coverage_audit`` (tests/mesh_audit_test.py covers
+    the meshes-section half)."""
     budgets = hlo_lint.load_budgets()
-    assert set(entry_points.ENTRY_POINTS) <= set(budgets["entry_points"])
+    assert set(entry_points.ENTRY_POINTS) == set(budgets["entry_points"])
 
 
 # ---- donation audit: real negative controls --------------------------------
