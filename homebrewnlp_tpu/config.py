@@ -371,6 +371,17 @@ class ModelParameter:
         # telemetry_enabled — profiling has no per-step cost until triggered
         self.telemetry_profile_on_signal = False
         self.telemetry_profile_steps = 10
+        # ---- multi-host runtime (docs/DISTRIBUTED.md) ----
+        # route checkpoint saves (cadence AND emergency) through the
+        # double-buffered background saver: the step thread pays only the
+        # device->host staging copy; serialization, fs writes, and the
+        # pod-wide commit barrier run on a saver thread
+        # (distributed/async_checkpoint.py).  Off = the synchronous save
+        self.checkpoint_async = False
+        # coordination-service barrier timeout (seconds) for the async
+        # checkpoint commit protocol: a peer that died mid-save surfaces as
+        # a named timeout here instead of hanging the pod forever
+        self.distributed_barrier_timeout_s = 600.0
 
         self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():
@@ -411,6 +422,10 @@ class ModelParameter:
         if self.telemetry_profile_steps < 1:
             raise ValueError("telemetry_profile_steps must be >= 1, got "
                              f"{self.telemetry_profile_steps}")
+        if self.distributed_barrier_timeout_s <= 0:
+            raise ValueError("distributed_barrier_timeout_s must be > 0 "
+                             "(it bounds the async-save commit rendezvous), "
+                             f"got {self.distributed_barrier_timeout_s}")
         if self.serve_request_deadline_s <= 0:
             raise ValueError("serve_request_deadline_s must be > 0 (it is "
                              "the default deadline, not just a cap), got "

@@ -1,0 +1,23 @@
+"""Multi-host runtime: slice-aware bootstrap, coordination-service
+helpers, and async sharded checkpointing (docs/DISTRIBUTED.md).
+
+The package turns the dryrun parallel strategies (scripts/pod_lowering.py,
+analysis/mesh_audit.py) into a launchable multi-process runtime:
+
+- ``bootstrap``        — ``jax.distributed.initialize`` wiring with explicit
+                         env flags for the CPU multiprocess rig and standard
+                         autodiscovery on TPU pods, a topology report, and
+                         coordination-service barrier/KV helpers that never
+                         touch the device path (safe from background
+                         threads while the step loop runs collectives).
+- ``async_checkpoint`` — double-buffered background checkpoint saver with a
+                         step-tagged commit barrier, so a pod checkpoint
+                         costs the step thread one host staging copy instead
+                         of the full serialize+upload+barrier stall.
+"""
+from .bootstrap import (barrier, coordination_client, is_initialized,
+                        kv_dir_get, kv_put, maybe_initialize, shutdown,
+                        topology_report)
+
+__all__ = ["maybe_initialize", "topology_report", "shutdown", "barrier",
+           "coordination_client", "is_initialized", "kv_put", "kv_dir_get"]

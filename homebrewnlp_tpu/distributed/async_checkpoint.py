@@ -1,0 +1,328 @@
+"""Async sharded checkpointing: double-buffered background saver with a
+step-tagged commit barrier (docs/DISTRIBUTED.md 'Async checkpoints').
+
+The synchronous save (train/checkpoint.py) holds the step thread through
+device→host staging AND serialization AND every fs write AND the pod-wide
+barriers — minutes for GB-scale state on gs://, all of it training stall.
+``AsyncCheckpointer`` splits the save at the only boundary donation allows:
+
+* **Staging stays on the submitting thread.**  The train step DONATES its
+  state buffers, so the next ``trainer.step`` call invalidates every device
+  array a background thread might still be reading — a concurrent
+  ``device_get`` is a use-after-free race, not an optimization.  ``submit``
+  therefore snapshots the state to host before returning: one
+  ``copy_to_host_async`` sweep primes every transfer, then one batched
+  ``device_get`` drains them (transfers overlap each other instead of
+  serializing per ~1GB chunk the way the sync path interleaves
+  fetch-then-write).  Cost to the step thread: the D2H copy, nothing else.
+* **Everything after the host copy runs on the saver thread**: tobytes,
+  checksums, shard files, manifests, the commit barrier, the directory
+  rename, pruning.  On remote storage this is the dominant 95%+ of save
+  wall time.
+
+Double buffering: at most one save is being written while one more may sit
+staged in the queue; a third ``submit`` blocks until the oldest commits, so
+host RAM holds at most two extra state copies no matter how hot the
+checkpoint cadence is.
+
+The commit barrier is **step-tagged and runs on the coordination service**
+(distributed/bootstrap.py ``barrier`` — gRPC to the coordinator, no device
+collectives), so the saver thread can rendezvous with its peers while the
+main threads are mid-collective in the next train step.  Barrier tags
+include a per-process submission sequence number: every process submits
+saves in the same order (the checkpoint cadence is step-driven and the
+emergency save goes through the pod-wide stop agreement), so sequence
+numbers agree and a re-save of the same step (cadence save then emergency
+save at step N) cannot collide with its predecessor's barrier ids.
+
+Failure semantics: an exception in the background save (storage outage,
+barrier timeout because a peer died mid-protocol) is held and re-raised at
+the next ``submit``/``flush`` — the same call sites where the synchronous
+save would have raised.  A save that dies between shard write and manifest
+commit leaves only a ``.tmp`` directory: ``restore_latest_valid`` never
+sees it, so a restart resumes from the previous committed checkpoint
+(fault-injected in tests/distributed_test.py).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import typing
+
+import numpy as np
+
+from ..train import checkpoint as ckpt
+from ..utils import fs
+from . import bootstrap
+
+
+class _Staged(typing.NamedTuple):
+    """Host-side snapshot of one save: everything the writer thread needs,
+    with zero references to device arrays."""
+    step: int
+    nproc: int
+    pid: int
+    #: full arrays this process writes: [(leaf_index, key, host_array)]
+    full: typing.List[typing.Tuple[int, str, np.ndarray]]
+    #: owned shards: [(leaf_index, key, shard_index, slice_spec,
+    #:                 global_shape, dtype_name, host_array)]
+    shards: typing.List[tuple]
+    extra: dict
+
+
+def stage(step: int, variables: dict, opt_state: dict,
+          extra: typing.Optional[dict] = None) -> _Staged:
+    """Snapshot the state tree to host memory (the only part of a save that
+    must happen before the next step donates the buffers).  Single process:
+    every leaf.  Multi-host: this process's owned shards (replica 0 of each
+    addressable shard) plus, on the chief, every non-distributed array —
+    the same writer-role split as the synchronous distributed save."""
+    import jax
+    tree = {"variables": variables, "opt_state": opt_state}
+    leaves = list(ckpt._leaf_files(tree))
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    chief_fetch: typing.List[tuple] = []
+    shard_meta: typing.List[tuple] = []
+    shard_refs: typing.List[typing.Any] = []
+    if nproc > 1:
+        for i, (key, value) in enumerate(leaves):
+            if ckpt._is_distributed(value):
+                for j, shard in enumerate(value.addressable_shards):
+                    if shard.replica_id != 0:
+                        continue  # a replicated copy some process owns
+                    shard_meta.append(
+                        (i, key, j, ckpt._slice_spec(shard.index, value.shape),
+                         list(value.shape), ckpt._dtype_name(value.dtype)))
+                    shard_refs.append(shard.data)
+            elif pid == 0:
+                chief_fetch.append((i, key, value))
+    else:
+        chief_fetch = [(i, key, v) for i, (key, v) in enumerate(leaves)]
+    # prime every D2H transfer, then drain: the copies overlap in flight
+    # instead of paying a serialized round trip per fetch
+    for ref in shard_refs:
+        _prime(ref)
+    for _, _, ref in chief_fetch:
+        _prime(ref)
+    fetched_shards = jax.device_get(shard_refs)
+    fetched_full = jax.device_get([v for _, _, v in chief_fetch])
+    return _Staged(
+        step=int(step), nproc=nproc, pid=pid,
+        full=[(i, key, np.asarray(h))
+              for (i, key, _), h in zip(chief_fetch, fetched_full)],
+        shards=[(*meta, np.asarray(h))
+                for meta, h in zip(shard_meta, fetched_shards)],
+        extra=dict(extra or {}))
+
+
+def _prime(value) -> None:
+    try:
+        value.copy_to_host_async()
+    except Exception:
+        pass  # numpy leaf / backend without async copies: device_get works
+
+
+def write_staged(model_path: str, staged: _Staged, max_keep: int,
+                 barrier_tag: str, barrier_timeout_s: float) -> str:
+    """The fs half of a save: serialize ``staged`` into ``ckpt_<step>``.
+    Runs entirely on host state — safe on any thread.  Multi-host commits
+    through three step-tagged coordination barriers (clear → save → done),
+    mirroring the synchronous save's sync_global_devices protocol without
+    touching the device path."""
+    step = staged.step
+    ckpt_dir = fs.join(model_path, f"ckpt_{step}")
+    tmp_dir = ckpt_dir + ".tmp"
+    if staged.nproc <= 1:
+        if ckpt._fsop(fs.exists, tmp_dir):
+            ckpt._fsop(fs.rmtree, tmp_dir)
+        ckpt._fsop(fs.makedirs, tmp_dir)
+        manifest = {"step": step, "process_index": 0, "arrays": {},
+                    "extra": staged.extra}
+        for i, key, host in staged.full:
+            manifest["arrays"][key] = ckpt._write_array_file(
+                tmp_dir, f"arr_{i:06d}.bin", host)
+        ckpt._write_json(fs.join(tmp_dir, "index.json"), manifest)
+        if ckpt._fsop(fs.exists, ckpt_dir):
+            ckpt._fsop(fs.rmtree, ckpt_dir)
+        # not retried (see the sync save: replace re-runs are not idempotent)
+        fs.replace(tmp_dir, ckpt_dir)
+        ckpt._prune(model_path, step, max_keep)
+        return ckpt_dir
+    pid = staged.pid
+    if pid == 0 and ckpt._fsop(fs.exists, tmp_dir):
+        ckpt._fsop(fs.rmtree, tmp_dir)
+    bootstrap.barrier(f"{barrier_tag}_clear", barrier_timeout_s)
+    ckpt._fsop(fs.makedirs, tmp_dir)
+    shard_entries = []
+    for i, key, j, index, global_shape, dtype, host in staged.shards:
+        meta = ckpt._write_array_file(
+            tmp_dir, f"arr_{i:06d}_p{pid}_s{j}.bin", host)
+        meta.pop("shape")
+        shard_entries.append({"key": key, "index": index,
+                              "global_shape": global_shape, **meta})
+    chief_arrays = {}
+    for i, key, host in staged.full:
+        chief_arrays[key] = ckpt._write_array_file(
+            tmp_dir, f"arr_{i:06d}.bin", host)
+    ckpt._write_json(fs.join(tmp_dir, f"shards_{pid}.json"),
+                     {"process_index": pid, "shards": shard_entries})
+    if pid == 0:
+        ckpt._write_json(fs.join(tmp_dir, "index.json"),
+                         {"step": step, "distributed": True,
+                          "process_count": staged.nproc,
+                          "arrays": chief_arrays, "extra": staged.extra})
+    # every process's shards + manifests must be durable before the rename
+    # makes the checkpoint visible — a peer that died above never reaches
+    # this barrier and the commit fails loudly on timeout instead of
+    # publishing a checkpoint missing that peer's shards
+    bootstrap.barrier(f"{barrier_tag}_save", barrier_timeout_s)
+    if pid == 0:
+        if ckpt._fsop(fs.exists, ckpt_dir):
+            ckpt._fsop(fs.rmtree, ckpt_dir)
+        fs.replace(tmp_dir, ckpt_dir)
+        ckpt._prune(model_path, step, max_keep)
+    bootstrap.barrier(f"{barrier_tag}_done", barrier_timeout_s)
+    return ckpt_dir
+
+
+class AsyncSaveError(RuntimeError):
+    """A background save failed; carries the step that was lost."""
+
+    def __init__(self, step: int, cause: BaseException):
+        super().__init__(f"async checkpoint save of step {step} failed: "
+                         f"{type(cause).__name__}: {cause}")
+        self.step = step
+        self.cause = cause
+
+
+class AsyncCheckpointer:
+    """Background checkpoint saver; one instance per training run.
+
+    ``submit`` stages on the calling thread and returns; the write/commit
+    runs on a daemon thread.  ``flush`` blocks until every submitted save
+    has committed (the emergency-save path calls it before exiting 143 so a
+    preemption cannot race a half-committed distributed checkpoint).
+    """
+
+    def __init__(self, barrier_timeout_s: float = 600.0):
+        self._timeout = float(barrier_timeout_s)
+        # maxsize 1 = double buffering: one save being written, at most one
+        # more staged and waiting; a third submit blocks on put()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._thread: typing.Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._error: typing.Optional[AsyncSaveError] = None
+        self._inflight = 0
+        self._idle = threading.Condition(self._lock)
+        self._seq = 0
+        self._closed = False
+
+    # -- public API ----------------------------------------------------------
+
+    def submit(self, model_path: str, step: int, variables: dict,
+               opt_state: dict, max_keep: int = 1,
+               extra: typing.Optional[dict] = None) -> str:
+        """Stage ``step``'s state to host and hand it to the saver thread.
+        Raises any error from a PREVIOUS background save (same contract as
+        the synchronous ``save`` raising at its call site)."""
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        t0 = time.monotonic()
+        staged = stage(step, variables, opt_state, extra)
+        ckpt._metrics()[1].labels(op="stage").observe(time.monotonic() - t0)
+        self._ensure_thread()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._inflight += 1
+        try:
+            self._queue.put((model_path, staged, max_keep, seq))
+        except BaseException:
+            with self._lock:
+                self._inflight -= 1
+            raise
+        return fs.join(model_path, f"ckpt_{int(step)}")
+
+    def flush(self, timeout: typing.Optional[float] = None) -> None:
+        """Block until every submitted save has committed; re-raise the
+        first background failure.  ``timeout`` bounds the wait PER SAVE
+        (None = barrier timeout + slack): each completed save resets the
+        clock, so two slow-but-healthy in-flight saves get two budgets —
+        only a save making no progress for a full budget times out."""
+        per_save = timeout if timeout is not None else self._timeout + 60.0
+        deadline = time.monotonic() + per_save
+        with self._idle:
+            last_inflight = self._inflight
+            while self._inflight > 0:
+                if self._inflight < last_inflight:
+                    # progress: a save committed — restart the budget for
+                    # the next one instead of abandoning it mid-write
+                    last_inflight = self._inflight
+                    deadline = time.monotonic() + per_save
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"async checkpoint flush timed out with "
+                        f"{self._inflight} save(s) still in flight")
+                self._idle.wait(timeout=min(remaining, 1.0))
+        self._raise_pending()
+
+    def take_error(self) -> typing.Optional["AsyncSaveError"]:
+        """Return-and-clear the held background failure without raising —
+        the emergency-save path uses this so an OLD cadence-save failure
+        cannot abort the NEW preemption checkpoint (it is logged and the
+        emergency save still runs)."""
+        with self._lock:
+            err, self._error = self._error, None
+        return err
+
+    def close(self, timeout: typing.Optional[float] = None) -> None:
+        """flush + stop accepting work (idempotent; the daemon thread dies
+        with the process)."""
+        if self._closed:
+            return
+        try:
+            self.flush(timeout=timeout)
+        finally:
+            self._closed = True
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    # -- internals -----------------------------------------------------------
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="hbnlp-async-ckpt", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            model_path, staged, max_keep, seq = self._queue.get()
+            t0 = time.monotonic()
+            try:
+                write_staged(model_path, staged, max_keep,
+                             barrier_tag=f"hbnlp_ckpt_{seq}_{staged.step}",
+                             barrier_timeout_s=self._timeout)
+                ckpt._metrics()[1].labels(op="save").observe(
+                    time.monotonic() - t0)
+            except BaseException as e:  # held for the next submit/flush
+                with self._lock:
+                    if self._error is None:
+                        self._error = AsyncSaveError(staged.step, e)
+            finally:
+                with self._idle:
+                    self._inflight -= 1
+                    self._idle.notify_all()

@@ -277,7 +277,14 @@ class ServingGuard:
             _reg().gauge(BREAKER_GAUGE,
                          "circuit breaker state: 0=closed 1=half_open 2=open"
                          ).set(BREAKER_STATES.get(breaker_state, 0))
-            snap = _snapshot()
+            # chief-only on a multi-host serving deployment: every host runs
+            # the same device loop over the SAME global computation, so a
+            # per-host /metrics scrape summed downstream would multiply
+            # every decode/token counter by the process count
+            # (docs/DISTRIBUTED.md).  Single-process (the normal serving
+            # topology) is unaffected.
+            import jax
+            snap = _snapshot() if jax.process_index() == 0 else {}
         except Exception:
             snap = {}
         state.update(hb=self.clock(),

@@ -226,6 +226,23 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     if is_chief:
         _dump_run_config(params)
 
+    # async checkpointing (docs/DISTRIBUTED.md): cadence + emergency saves
+    # go through the double-buffered background saver — the step thread pays
+    # only the device->host staging copy.  Every process routes through the
+    # SAME path (the distributed write protocol assigns writer roles).
+    saver = None
+    if params.use_checkpointing and params.checkpoint_async:
+        from ..distributed.async_checkpoint import AsyncCheckpointer
+        saver = AsyncCheckpointer(params.distributed_barrier_timeout_s)
+
+    def save_state(at_step: int) -> None:
+        if saver is not None:
+            saver.submit(params.model_path, at_step, state.variables,
+                         state.opt_state, params.max_checkpoints_keep)
+        else:
+            ckpt.save(params.model_path, at_step, state.variables,
+                      state.opt_state, params.max_checkpoints_keep)
+
     # restore through the corruption fallback: a torn/corrupt latest
     # checkpoint costs one checkpoint interval, not the run; strict = an
     # all-corrupt model_path refuses to train from scratch over the corpse
@@ -305,12 +322,19 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     tel_nonfinite = tel_preempt = None
     tel_jsonl = None
     tel_jsonl_last = [0.0]
+    tel_publish = tel_gather = None
     tel_mfu = tel_tokens = None
     mfu_flops_per_step = 0.0
     mfu_peak_total = 1.0
     if params.telemetry_enabled:
         from .. import telemetry
         telemetry.register_build_info()
+        if jax.process_count() > 1:
+            # every exported series names the host it came from; the chief's
+            # cross-host merge then unions per-process series instead of
+            # summing different hosts into anonymity (docs/DISTRIBUTED.md)
+            telemetry.set_constant_labels(
+                {"process": str(jax.process_index())})
         if params.telemetry_chrome_trace_events:
             tel_trace = telemetry.ChromeTrace(
                 params.telemetry_chrome_trace_events)
@@ -327,27 +351,32 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # per-step gauge is ledger-FLOPs / measured step time / peak.
         # Failure to trace (e.g. exotic video configs) degrades to no gauge,
         # never to a dead run.
-        tel_tokens = reg.counter(
-            "hbnlp_train_tokens_total",
-            "tokens fed to the device (rate() of this is tokens/sec)")
-        try:
-            from ..utils import flops as flops_mod
-            micro = {k: v[0] if params.macro_batching > 1 else v
-                     for k, v in first_batch.items() if v is not None}
-            fwd = flops_mod.forward_flops(
-                lambda v, b: model.apply(v, b).total_loss.data,
-                state.variables, micro)
-            # 3x-forward convention (forward + 2x backward, no remat
-            # credit) x the micro steps one loop iteration executes
-            mfu_flops_per_step = 3.0 * fwd * max(1, params.macro_batching)
-            mfu_peak_total = flops_mod.peak_flops() * max(1, len(devices))
-            tel_mfu = reg.gauge(
-                "hbnlp_train_mfu",
-                "model FLOPs utilization of the last step (3x-forward "
-                "analytical FLOPs / measured step time / peak)")
-        except Exception as exc:
-            print(f"WARNING: MFU gauge disabled (FLOP trace failed: {exc})",
-                  flush=True)
+        # chief-only: tokens_per_step and the MFU FLOP count are GLOBAL
+        # quantities — every host registering them would make a cross-host
+        # merge (or a per-host scrape summed downstream) report N× the real
+        # token rate and utilization
+        if is_chief:
+            tel_tokens = reg.counter(
+                "hbnlp_train_tokens_total",
+                "tokens fed to the device (rate() of this is tokens/sec)")
+            try:
+                from ..utils import flops as flops_mod
+                micro = {k: v[0] if params.macro_batching > 1 else v
+                         for k, v in first_batch.items() if v is not None}
+                fwd = flops_mod.forward_flops(
+                    lambda v, b: model.apply(v, b).total_loss.data,
+                    state.variables, micro)
+                # 3x-forward convention (forward + 2x backward, no remat
+                # credit) x the micro steps one loop iteration executes
+                mfu_flops_per_step = 3.0 * fwd * max(1, params.macro_batching)
+                mfu_peak_total = flops_mod.peak_flops() * max(1, len(devices))
+                tel_mfu = reg.gauge(
+                    "hbnlp_train_mfu",
+                    "model FLOPs utilization of the last step (3x-forward "
+                    "analytical FLOPs / measured step time / peak)")
+            except Exception as exc:
+                print(f"WARNING: MFU gauge disabled (FLOP trace failed: "
+                      f"{exc})", flush=True)
         if is_chief and params.telemetry_jsonl_interval_s > 0:
             tel_jsonl = fs.open_(fs.join(params.model_path,
                                          "telemetry.jsonl"), "a")
@@ -356,6 +385,34 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             tel_jsonl.write(json.dumps(
                 {"build_info": telemetry.build_info()}) + "\n")
             tel_jsonl.flush()
+        # cross-host merge (docs/DISTRIBUTED.md): non-chief hosts publish
+        # their (process-labeled) snapshots over the coordination KV store
+        # at the jsonl cadence; the chief merges the freshest peer snapshots
+        # with its own into ONE telemetry.jsonl.  Counters/histograms keep
+        # per-process series (the label makes them distinct), gauges stay
+        # per-host truth.  No device collectives anywhere on this path.
+        if jax.process_count() > 1 and params.telemetry_jsonl_interval_s > 0:
+            import base64
+            import pickle
+            from .. import distributed as dist_mod
+            if not is_chief:
+                def tel_publish():
+                    dist_mod.kv_put(
+                        f"hbnlp/telemetry/p{jax.process_index()}",
+                        base64.b64encode(
+                            pickle.dumps(telemetry.snapshot())).decode())
+            else:
+                def tel_gather():
+                    peers = []
+                    for _, val in dist_mod.kv_dir_get("hbnlp/telemetry/"):
+                        try:
+                            peers.append(pickle.loads(
+                                base64.b64decode(val.encode())))
+                        except Exception:
+                            pass  # torn publish: skip this peer this tick
+                    snap = telemetry.snapshot()
+                    return telemetry.merge_snapshots(*peers, snap) \
+                        if peers else snap
     # on-demand XLA profiling is independent of telemetry_enabled: it has
     # zero per-step cost until a SIGUSR2 actually requests a capture
     profiler_od = None
@@ -548,19 +605,22 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 if logger is not None:
                     logger.log(step_now, metrics,
                                tokens_per_step=params.train_batch_size * params.sequence_length)
-                if tel_jsonl is not None and \
+                if (tel_jsonl is not None or tel_publish is not None) and \
                         mono() - tel_jsonl_last[0] >= params.telemetry_jsonl_interval_s:
-                    tel_jsonl.write(telemetry.jsonl_line(
-                        telemetry.snapshot(), step=step_now) + "\n")
-                    tel_jsonl.flush()
+                    if tel_publish is not None:
+                        tel_publish()
+                    else:
+                        tel_jsonl.write(telemetry.jsonl_line(
+                            tel_gather() if tel_gather is not None
+                            else telemetry.snapshot(), step=step_now) + "\n")
+                        tel_jsonl.flush()
                     tel_jsonl_last[0] = mono()
             # every process participates in a distributed save (the save
             # itself barriers and assigns writer roles); single-process
             # saves are chief-trivially
             if params.use_checkpointing and \
                     step_now % params.steps_per_checkpoint < params.macro_batching:
-                ckpt.save(params.model_path, step_now, state.variables,
-                          state.opt_state, params.max_checkpoints_keep)
+                save_state(step_now)
             if should_stop(it_count):
                 # graceful preemption: the in-flight step finished; fall
                 # through to the finally path's emergency checkpoint + run
@@ -587,8 +647,23 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     # close() below never ran when save raised at all)
                     logger.flush()
                 if params.use_checkpointing:
-                    ckpt.save(params.model_path, int(state.step), state.variables,
-                              state.opt_state, params.max_checkpoints_keep)
+                    # emergency save participates in the async saver's
+                    # commit barrier: submit, then FLUSH the in-flight
+                    # background save(s) before this process exits — a
+                    # preemption must not race a half-committed
+                    # distributed checkpoint (docs/DISTRIBUTED.md).  A
+                    # held failure from an EARLIER cadence save is logged
+                    # and cleared first: it must not abort the one
+                    # checkpoint this path exists to write
+                    if saver is not None:
+                        old_err = saver.take_error()
+                        if old_err is not None:
+                            print(f"WARNING: earlier background save "
+                                  f"failed ({old_err}); attempting the "
+                                  "emergency save anyway", flush=True)
+                    save_state(int(state.step))
+                    if saver is not None:
+                        saver.close()
                 # rewrite the run log entry with the steps actually consumed
                 log = read_runs_log(params) \
                     if is_chief and not params.use_random_dataloader else None
@@ -600,12 +675,27 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             finally:
                 # runs even when the emergency save raises — the metrics
                 # files must never be the casualty of a storage failure
+                if saver is not None:
+                    try:
+                        # idempotent: a second close after the happy-path
+                        # one above is a no-op; after a raise mid-finally
+                        # this is what drains the in-flight save
+                        saver.close()
+                    except Exception as e:
+                        print(f"WARNING: async checkpoint flush failed: {e}",
+                              flush=True)
                 if logger is not None:
                     logger.close()
+                if tel_publish is not None:
+                    try:
+                        tel_publish()  # peers' final counters for the chief
+                    except Exception:
+                        pass
                 if tel_jsonl is not None:
                     try:
                         tel_jsonl.write(telemetry.jsonl_line(
-                            telemetry.snapshot(), step=step_now) + "\n")
+                            tel_gather() if tel_gather is not None
+                            else telemetry.snapshot(), step=step_now) + "\n")
                         tel_jsonl.close()
                     except Exception as e:
                         print(f"WARNING: final telemetry.jsonl write failed:"

@@ -20,14 +20,15 @@ from .buildinfo import build_info, register_build_info
 from .profiler import OnDemandProfiler
 from .registry import (DEFAULT_BUCKETS, Registry, histogram_quantile,
                        jsonl_line, merge_snapshots, prometheus_text,
-                       registry, render_json, set_registry, snapshot,
-                       summarize)
+                       registry, render_json, set_constant_labels,
+                       set_registry, snapshot, summarize, with_labels)
 from .spans import SPAN_METRIC, ChromeTrace, Phase, StepPhases, span
 
 __all__ = [
     "DEFAULT_BUCKETS", "Registry", "histogram_quantile", "jsonl_line",
     "merge_snapshots", "prometheus_text", "registry", "render_json",
-    "set_registry", "snapshot", "summarize",
+    "set_constant_labels", "set_registry", "snapshot", "summarize",
+    "with_labels",
     "SPAN_METRIC", "ChromeTrace", "Phase", "StepPhases", "span",
     "OnDemandProfiler", "build_info", "register_build_info",
 ]

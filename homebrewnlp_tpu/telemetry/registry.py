@@ -365,10 +365,48 @@ def summarize(snap: dict) -> dict:
     return out
 
 
+def with_labels(snap: dict, labels: typing.Dict[str, str]) -> dict:
+    """A copy of ``snap`` with constant ``labels`` appended to EVERY series
+    (label names already present on a metric are left alone — the caller's
+    per-series value wins).  This is how multi-host snapshots carry their
+    process identity: each host tags its own snapshot once, and
+    ``merge_snapshots`` then unions the per-process series instead of
+    summing counters that belong to different hosts into anonymity."""
+    out: dict = {}
+    for name, m in snap.items():
+        have = tuple(m.get("labels", ()))
+        add = [(k, str(v)) for k, v in sorted(labels.items())
+               if k not in have]
+        names = have + tuple(k for k, _ in add)
+        values = tuple(v for _, v in add)
+        out[name] = {"kind": m["kind"], "help": m.get("help", ""),
+                     "labels": names, "buckets": list(m.get("buckets", ())),
+                     "series": {tuple(key) + values:
+                                (dict(counts=list(v["counts"]), sum=v["sum"])
+                                 if isinstance(v, dict) else v)
+                                for key, v in m["series"].items()}}
+    return out
+
+
 # ---- process-wide instance --------------------------------------------------
 
 _registry = Registry()
 _registry_lock = threading.Lock()
+
+#: constant labels stamped onto every module-level ``snapshot()`` — the
+#: multi-host bootstrap sets {"process": "<index>"} once so every exported
+#: series (jsonl, /metrics, cross-host merge) names the host it came from
+_constant_labels: typing.Dict[str, str] = {}
+
+
+def set_constant_labels(labels: typing.Optional[typing.Dict[str, str]]
+                        ) -> typing.Dict[str, str]:
+    """Install the constant labels ``snapshot()`` applies (None/{} clears);
+    returns the previous mapping so tests can restore it."""
+    global _constant_labels
+    prev = _constant_labels
+    _constant_labels = dict(labels or {})
+    return prev
 
 
 def registry() -> Registry:
@@ -388,4 +426,5 @@ def set_registry(reg: typing.Optional[Registry]) -> Registry:
 
 
 def snapshot() -> dict:
-    return registry().snapshot()
+    snap = registry().snapshot()
+    return with_labels(snap, _constant_labels) if _constant_labels else snap

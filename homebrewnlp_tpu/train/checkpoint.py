@@ -112,6 +112,20 @@ def _write_json(path: str, obj) -> None:
     _write_bytes(path, json.dumps(obj).encode("utf-8"))
 
 
+def _write_array_file(tmp_dir: str, fname: str, host: np.ndarray) -> dict:
+    """Serialize one host array into ``tmp_dir/fname`` and return its
+    manifest entry (shape/dtype/bytes/crc).  Shared by the synchronous
+    save paths below and the background saver
+    (distributed/async_checkpoint.py) so the two can never disagree on
+    the on-disk format."""
+    data = host.tobytes()
+    algo, crc = _checksum(data)
+    _write_bytes(fs.join(tmp_dir, fname), data)
+    return {"file": fname, "shape": list(host.shape),
+            "dtype": _dtype_name(host.dtype), "bytes": len(data),
+            "crc": crc, "crc_algo": algo}
+
+
 # -- array-file integrity ----------------------------------------------------
 
 def _checksum(data: bytes) -> typing.Tuple[str, int]:
@@ -281,16 +295,8 @@ def _save_inner(model_path: str, step: int, variables, opt_state,
             i += 1
         fetched = jax.device_get([v for _, _, v in chunk])
         for (idx, key, _), value in zip(chunk, fetched):
-            host = np.asarray(value)
-            fname = f"arr_{idx:06d}.bin"
-            data = host.tobytes()
-            algo, crc = _checksum(data)
-            _write_bytes(fs.join(tmp_dir, fname), data)
-            manifest["arrays"][key] = {"file": fname,
-                                       "shape": list(host.shape),
-                                       "dtype": _dtype_name(host.dtype),
-                                       "bytes": len(data),
-                                       "crc": crc, "crc_algo": algo}
+            manifest["arrays"][key] = _write_array_file(
+                tmp_dir, f"arr_{idx:06d}.bin", np.asarray(value))
     _write_json(fs.join(tmp_dir, "index.json"), manifest)
     if _fsop(fs.exists, ckpt_dir):
         _fsop(fs.rmtree, ckpt_dir)
@@ -363,28 +369,17 @@ def _save_distributed(model_path: str, step: int, variables, opt_state,
     # chunks around)
     fetched_shards = jax.device_get(shard_data_refs)
     for (i, key, j, index, value), host in zip(shard_meta, fetched_shards):
-        fname = f"arr_{i:06d}_p{pid}_s{j}.bin"
-        data = np.asarray(host).tobytes()
-        algo, crc = _checksum(data)
-        _write_bytes(fs.join(tmp_dir, fname), data)
+        meta = _write_array_file(tmp_dir, f"arr_{i:06d}_p{pid}_s{j}.bin",
+                                 np.asarray(host))
+        meta.pop("shape")
         shard_entries.append({
-            "key": key, "file": fname,
-            "index": _slice_spec(index, value.shape),
-            "global_shape": list(value.shape),
-            "dtype": _dtype_name(value.dtype),
-            "bytes": len(data), "crc": crc, "crc_algo": algo})
+            "key": key, "index": _slice_spec(index, value.shape),
+            "global_shape": list(value.shape), **meta})
     if pid == 0:
         fetched = jax.device_get([v for _, _, v in chief_fetch])
         for (i, key, _), value in zip(chief_fetch, fetched):
-            host = np.asarray(value)
-            fname = f"arr_{i:06d}.bin"
-            data = host.tobytes()
-            algo, crc = _checksum(data)
-            _write_bytes(fs.join(tmp_dir, fname), data)
-            chief_arrays[key] = {"file": fname, "shape": list(host.shape),
-                                 "dtype": _dtype_name(host.dtype),
-                                 "bytes": len(data),
-                                 "crc": crc, "crc_algo": algo}
+            chief_arrays[key] = _write_array_file(
+                tmp_dir, f"arr_{i:06d}.bin", np.asarray(value))
     _write_json(fs.join(tmp_dir, f"shards_{pid}.json"),
                 {"process_index": pid, "shards": shard_entries})
     if pid == 0:

@@ -8,7 +8,6 @@ compatibility (TPU connection is implicit through jax; no TF1 session).
 """
 import argparse
 import json
-import os
 import sys
 
 
@@ -27,11 +26,12 @@ def main():
     ap.add_argument("--debug_grad", action="store_true")
     args = ap.parse_args()
 
-    # multi-host pods: jax.distributed discovers peers from the standard env
-    # (the reference resolved a TPUClusterResolver here, src/main.py:107-117)
-    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        import jax
-        jax.distributed.initialize()
+    # multi-host: explicit HBNLP_* flags (the CPU multiprocess rig /
+    # run_manager --num-processes) or the standard env / TPU pod metadata
+    # (the reference resolved a TPUClusterResolver here, src/main.py:107-117).
+    # Single-process runs skip this entirely (docs/DISTRIBUTED.md).
+    from homebrewnlp_tpu.distributed import bootstrap as dist_bootstrap
+    dist_bootstrap.maybe_initialize()
 
     with open(args.model) as f:
         config = json.load(f)
@@ -67,7 +67,13 @@ def main():
 
     # train_mode returns PREEMPTED_EXIT_CODE (143) after a SIGTERM-triggered
     # emergency checkpoint so supervisors relaunch instead of finishing
-    rc = RUN_MODE_FNS[args.run_mode](params, args)
+    try:
+        rc = RUN_MODE_FNS[args.run_mode](params, args)
+    finally:
+        # clean disconnect from the coordinator — including on the
+        # preemption path, so peers fail their next barrier with a named
+        # error instead of a gRPC reset (no-op unless bootstrap initialized)
+        dist_bootstrap.shutdown()
     return int(rc) if rc else 0
 
 

@@ -22,8 +22,12 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import typing
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 
 
 #: training exits with this code after a SIGTERM-triggered emergency
@@ -202,6 +206,134 @@ class Manager:
             sh(self.args.delete_cmd)
 
 
+def _free_port() -> int:
+    from homebrewnlp_tpu.distributed.bootstrap import free_port
+    return free_port()
+
+
+class Fleet(Manager):
+    """Slice-aware local fan-out (docs/DISTRIBUTED.md): N coordinator-wired
+    processes on THIS host — the CPU multiprocess rig, and the shape a
+    per-host pod launcher drives one host at a time.
+
+    Each worker gets the explicit-flag bootstrap env
+    (``HBNLP_COORDINATOR``/``HBNLP_NUM_PROCESSES``/``HBNLP_PROCESS_ID``,
+    homebrewnlp_tpu/distributed/bootstrap.py) plus — on the CPU rig — a
+    forced CPU backend with ``--devices-per-process`` virtual devices.
+    Output is multiplexed into the manager log with a ``[pN]`` prefix per
+    line.
+
+    Restart semantics mirror the single-process manager, fleet-wide:
+
+    - ANY worker exiting 143 = pod-wide preemption (the chief-flag
+      broadcast inside the train loop makes every worker stop and write
+      the SAME emergency checkpoint) → wait for the rest, relaunch ALL
+      without consuming the crash budget.
+    - any worker crashing (nonzero, non-143) → its peers are already doomed
+      (their next collective would hang on the dead rank) → TERM the rest,
+      relaunch ALL, consuming one restart.
+    - all zero → done.
+    """
+
+    def __init__(self, args):
+        super().__init__(args)
+        self._pump_threads: typing.List[threading.Thread] = []
+
+    def _pump(self, pid: int, stream):
+        """Per-process log prefixing: every worker line lands in the
+        manager log as ``[pN] line`` (reader thread per worker — pipes
+        would deadlock on a filled buffer otherwise)."""
+        for line in iter(stream.readline, ""):
+            self.out(f"[p{pid}] {line.rstrip()}")
+        stream.close()
+
+    def launch_fleet(self) -> typing.List[subprocess.Popen]:
+        n = self.args.num_processes
+        port = _free_port()  # fresh per generation: no TIME_WAIT rebind race
+        self.out(f"launching fleet: {n} processes, coordinator "
+                 f"localhost:{port}: {self.args.run_command}")
+        procs = []
+        for pid in range(n):
+            env = dict(os.environ,
+                       HBNLP_COORDINATOR=f"localhost:{port}",
+                       HBNLP_NUM_PROCESSES=str(n),
+                       HBNLP_PROCESS_ID=str(pid))
+            if self.args.cpu_rig:
+                import re
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "",
+                    env.get("XLA_FLAGS", ""))
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{self.args.devices_per_process}")
+            p = subprocess.Popen(self.args.run_command, shell=True, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True,
+                                 preexec_fn=os.setsid)
+            t = threading.Thread(target=self._pump, args=(pid, p.stdout),
+                                 daemon=True)
+            t.start()
+            self._pump_threads.append(t)
+            procs.append(p)
+        return procs
+
+    def kill_fleet(self, procs, grace: typing.Optional[int] = None):
+        for p in procs:
+            if p.poll() is None:
+                self.kill(p, grace=grace)
+
+    def run(self):
+        procs = self.launch_fleet()
+        restarts = 0
+        while True:
+            time.sleep(self.args.poll_interval
+                       + random.randint(0, self.args.poll_jitter))
+            rcs = [p.poll() for p in procs]
+            stalled = (self.args.stall_timeout > 0
+                       and self.heartbeat_age() > self.args.stall_timeout)
+            if all(rc is None for rc in rcs) and not stalled:
+                continue
+            preempted = any(rc == PREEMPTED_RC for rc in rcs)
+            crashed = any(rc not in (None, 0, PREEMPTED_RC) for rc in rcs)
+            if not preempted and not crashed and not stalled \
+                    and any(rc is None for rc in rcs):
+                # staggered CLEAN finish: some workers exited 0 while the
+                # chief is still flushing final artifacts (telemetry dump,
+                # async-checkpoint close on slow storage) — keep waiting;
+                # a worker that never finishes is the stall detector's job
+                continue
+            if preempted:
+                # clean pod-wide preemption: peers agreed via the chief-flag
+                # broadcast — give stragglers the full checkpoint grace
+                # before escalating, then relaunch WITHOUT consuming budget
+                self.out(f"fleet preempted (rcs={rcs}): waiting for peers, "
+                         "then relaunching")
+                deadline = time.monotonic() + getattr(
+                    self.args, "term_grace", 600)
+                while any(p.poll() is None for p in procs) \
+                        and time.monotonic() < deadline:
+                    time.sleep(1)
+                self.kill_fleet(procs, grace=15)
+            elif all(rc == 0 for rc in rcs):
+                self.out("fleet finished cleanly; done")
+                break
+            else:
+                # crash or stall: a dead rank hangs every peer's next
+                # collective — tear the whole generation down and relaunch
+                restarts += 1
+                if 0 < self.args.max_restarts < restarts:
+                    self.out(f"fleet rcs={rcs} stalled={stalled}; max "
+                             "restarts exceeded; giving up")
+                    self.kill_fleet(procs, grace=15)
+                    return
+                self.out(f"fleet unhealthy (rcs={rcs} stalled={stalled}); "
+                         f"restarting (#{restarts})")
+                self.kill_fleet(procs, grace=15 if stalled else None)
+            time.sleep(self.args.restart_delay)
+            procs = self.launch_fleet()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("run_command", help="training command to supervise")
@@ -217,7 +349,29 @@ def main():
                          "process to finish its emergency checkpoint "
                          "before SIGKILL")
     ap.add_argument("--max-restarts", type=int, default=0, help="0 = unlimited")
-    Manager(ap.parse_args()).run()
+    ap.add_argument("--num-processes", type=int, default=0,
+                    dest="num_processes",
+                    help="fan out N coordinator-wired local processes "
+                         "(docs/DISTRIBUTED.md); 0 = supervise run_command "
+                         "as a single process (the per-host pod shape)")
+    ap.add_argument("--devices-per-process", type=int, default=1,
+                    dest="devices_per_process",
+                    help="virtual CPU devices per fanned-out process "
+                         "(--cpu-rig only)")
+    ap.add_argument("--cpu-rig", action="store_true", default=True,
+                    dest="cpu_rig",
+                    help="force JAX_PLATFORMS=cpu + virtual devices in the "
+                         "fleet (default; --no-cpu-rig passes the "
+                         "environment through for accelerator hosts)")
+    ap.add_argument("--no-cpu-rig", action="store_false", dest="cpu_rig")
+    ap.add_argument("--restart-delay", type=int, default=5,
+                    dest="restart_delay",
+                    help="seconds between fleet teardown and relaunch")
+    args = ap.parse_args()
+    if args.num_processes > 0:
+        Fleet(args).run()
+    else:
+        Manager(args).run()
 
 
 if __name__ == "__main__":

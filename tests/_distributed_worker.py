@@ -1,0 +1,381 @@
+"""Worker for tests/distributed_test.py: one mode per scenario, run as
+
+  python _distributed_worker.py <port> <pid> <nproc> <mode> <json-args>
+
+with JAX_PLATFORMS=cpu and 4 virtual devices per process (the 2-process
+runs form an 8-device multi-controller CPU pod).  Discovery goes through
+the REAL bootstrap (homebrewnlp_tpu/distributed/bootstrap.py explicit-flag
+env path + gloo CPU collectives), so every mode is also a bootstrap test.
+
+Modes (each prints greppable marker lines the parent asserts on):
+
+- ``lockstep``  — N deterministic trainer steps over a synthetic global
+  batch; chief prints the full-precision loss sequence.  The parent runs
+  the SAME function single-process (8 in-process devices, identical mesh)
+  and compares bit-exact.
+- ``save``      — deterministic state, one step, async distributed save at
+  step 7, then one more step whose loss is the restore reference.
+- ``restore``   — restore the mode-``save`` checkpoint at THIS process
+  count, lay it onto the live mesh, run the same step, print its loss.
+- ``overlap``   — per-iteration wall times with checkpoint submits riding
+  a deliberately SLOW object store: proves the async saver keeps
+  checkpoint-cadence steps at plain-step cost (and that the synchronous
+  save measurably does not).
+- ``faultsave`` — a good save, then a save where process 1's storage
+  crashes BETWEEN shard write and manifest commit (FaultInjectionFS over
+  the shared disk store): both processes must surface the failure, the
+  torn save must stay invisible, and restore must fall back to the good
+  checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import typing
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from homebrewnlp_tpu.utils import fs as fslib  # noqa: E402
+
+
+class DiskStoreFS(fslib._ObjectStoreFS):
+    """Object store over a SHARED local directory: the cross-process
+    stand-in for gs:// in multi-process tests (MemFS is per-process).
+    Optional per-write delay turns it into a slow remote bucket for the
+    async-overlap measurement; ``FaultInjectionFS`` wraps it for the
+    crash schedules."""
+
+    def __init__(self, base: str, write_delay: float = 0.0):
+        self.base = base
+        self.write_delay = write_delay
+        self._tmp = base.rstrip("/") + ".inflight"
+        os.makedirs(base, exist_ok=True)
+        os.makedirs(self._tmp, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self.base, key.split("://", 1)[1])
+
+    def _keys(self, prefix):
+        out = []
+        for dirpath, _, files in os.walk(self.base):
+            for f in files:
+                rel = os.path.relpath(os.path.join(dirpath, f), self.base)
+                out.append("dstore://" + rel.replace(os.sep, "/"))
+        return sorted(k for k in out
+                      if k == prefix
+                      or k.startswith(prefix.rstrip("/") + "/")
+                      or (prefix.endswith("/") and k.startswith(prefix)))
+
+    def _read(self, key):
+        p = self._p(key)
+        if not os.path.isfile(p):
+            raise FileNotFoundError(key)
+        with open(p, "rb") as f:
+            return f.read()
+
+    def _write(self, key, data):
+        if self.write_delay:
+            time.sleep(self.write_delay)
+        p = self._p(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        # atomic publish via a staging dir OUTSIDE the walked tree, so
+        # readers never glimpse half-written objects as keys
+        tmp = os.path.join(self._tmp, f"{os.getpid()}_{abs(hash(key))}")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+    def _delete(self, key):
+        try:
+            os.remove(self._p(key))
+        except FileNotFoundError:
+            pass
+
+
+def _model_cfg(model_path: str, mesh: dict, **overrides) -> dict:
+    cfg = {
+        "model_mode": "gpt", "use_video": False, "use_language": True,
+        "sequence_length": 32, "features_per_head": 16, "heads": 8,
+        "depth": 1, "train_batch_size": 8, "vocab_size": 32, "tpu_size": 8,
+        "block_config": [{"layer": ["norm-shift-scale-features-group",
+                                    "feed_forward-in:relu"]}],
+        "memory_reduction_strategy": "none",
+        "optimizer": "adam-learning_rate", "learning_rate": 1e-3,
+        "weight_decay": 0.0, "storage_retry_base_delay": 0.0,
+        "mesh_shape_override": mesh, "model_path": model_path,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def _setup(cfg: dict):
+    import jax
+    import numpy as np
+
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.core import sharding as shardlib
+    from homebrewnlp_tpu.model import Model
+    from homebrewnlp_tpu.train import Trainer
+
+    params = ModelParameter(dict(cfg))
+    mesh = shardlib.build_mesh(params)
+    trainer = Trainer(params, Model(params), mesh=mesh)
+    if jax.process_count() > 1:
+        slice_index, slice_count = shardlib.process_data_slice(mesh)
+    else:
+        slice_index, slice_count = 0, 1
+    gb = params.train_batch_size
+    rng = np.random.default_rng(42)  # GLOBAL batch, identical in every mode
+    x = rng.integers(0, params.vocab_size, (gb, params.sequence_length, 1))
+    local = gb // slice_count
+    rows = slice(slice_index * local, (slice_index + 1) * local)
+    batch = {"token_x": np.asarray(x[rows], np.int32),
+             "token_y": np.asarray((x[rows] + 1) % params.vocab_size,
+                                   np.int32)}
+    return params, trainer, batch
+
+
+def run_lockstep(cfg: dict, steps: int) -> typing.List[float]:
+    """Deterministic step sequence; also called IN-PROCESS by the parent
+    test for the single-process reference (same mesh, same global batch,
+    same per-step keys)."""
+    import jax
+    import numpy as np
+
+    params, trainer, batch = _setup(cfg)
+    state = trainer.init_state(batch)
+    losses = []
+    for i in range(steps):
+        state, metrics = trainer.step(state, batch,
+                                      rng=jax.random.PRNGKey(100 + i))
+        losses.append(float(np.asarray(jax.device_get(metrics["loss"]))))
+    return losses
+
+
+def _mode_lockstep(args: dict) -> None:
+    import jax
+    losses = run_lockstep(args["cfg"], args["steps"])
+    if jax.process_index() == 0:
+        print("LOCKSTEP " + json.dumps([repr(v) for v in losses]),
+              flush=True)
+
+
+def _single_device_loss(params, variables_host: dict) -> float:
+    """Forward loss of the restored parameters on ONE device with the full
+    global batch — no mesh, no collectives, so the value is bit-identical
+    no matter how many processes (or devices) the restore ran under.  This
+    is the cross-topology 'identical post-restore loss' probe: sharded
+    step losses differ in the last float32 bits between topologies because
+    collective implementations order the reduction differently."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from homebrewnlp_tpu.model import Model
+
+    rng = np.random.default_rng(42)
+    gb = params.train_batch_size
+    x = rng.integers(0, params.vocab_size, (gb, params.sequence_length, 1))
+    batch = {"token_x": jnp.asarray(x, jnp.int32),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size, jnp.int32)}
+    model = Model(params)
+    template = model.init({k: np.asarray(v) for k, v in batch.items()})
+    fn = jax.jit(lambda v, b: model.apply(v, b).total_loss.data)
+    host_vars = {k: jnp.asarray(np.asarray(variables_host[k]))
+                 for k in template}
+    return float(np.asarray(jax.device_get(fn(host_vars, batch))))
+
+
+def _mode_save(args: dict) -> None:
+    import jax
+    import numpy as np
+
+    from homebrewnlp_tpu.distributed.async_checkpoint import AsyncCheckpointer
+    from homebrewnlp_tpu.train import checkpoint as ckpt
+
+    params, trainer, batch = _setup(args["cfg"])
+    state = trainer.init_state(batch)
+    state, _ = trainer.step(state, batch, rng=jax.random.PRNGKey(100))
+    if jax.process_count() > 1:
+        spanning = [k for k, v in state.variables.items()
+                    if not v.is_fully_addressable]
+        assert spanning, "expected model-sharded params to span processes"
+    saver = AsyncCheckpointer(params.distributed_barrier_timeout_s)
+    saver.submit(params.model_path, 7, state.variables, state.opt_state,
+                 max_keep=2)
+    saver.close()
+    # live-continuation reference: one more sharded step from the saved
+    # state (restores compare against it within reduction-order tolerance)
+    _, metrics = trainer.step(state, batch, rng=jax.random.PRNGKey(200))
+    live = float(np.asarray(jax.device_get(metrics["loss"])))
+    if jax.process_index() == 0:
+        restored = ckpt.restore(params.model_path, 7)
+        ref = _single_device_loss(params, restored[0])
+        print(f"SAVE_REF_LOSS {ref!r}", flush=True)
+        print(f"SAVE_LIVE_LOSS {live!r}", flush=True)
+
+
+def _mode_restore(args: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from homebrewnlp_tpu.core import sharding as shardlib
+    from homebrewnlp_tpu.train import TrainState, checkpoint as ckpt
+
+    params, trainer, batch = _setup(args["cfg"])
+    state = trainer.init_state(batch)  # sharding template on THIS mesh
+    restored = ckpt.restore_latest_valid(params.model_path, strict=True)
+    assert restored is not None and restored[2] == 7, restored and restored[2]
+    variables = {k: np.asarray(v).astype(state.variables[k].dtype)
+                 for k, v in restored[0].items()}
+    st = TrainState(shardlib.place_tree(state.variables, variables),
+                    shardlib.place_tree(state.opt_state, restored[1]),
+                    jnp.asarray(restored[2], jnp.int32))
+    _, metrics = trainer.step(st, batch, rng=jax.random.PRNGKey(200))
+    live = float(np.asarray(jax.device_get(metrics["loss"])))
+    if jax.process_index() == 0:
+        print(f"RESTORE_LOSS {_single_device_loss(params, restored[0])!r}",
+              flush=True)
+        print(f"RESTORE_LIVE_LOSS {live!r}", flush=True)
+
+
+def _mode_overlap(args: dict) -> None:
+    import jax
+    import numpy as np
+
+    from homebrewnlp_tpu.distributed.async_checkpoint import AsyncCheckpointer
+    from homebrewnlp_tpu.train import checkpoint as ckpt
+
+    fslib.register("dstore", DiskStoreFS(args["store"],
+                                         write_delay=args["write_delay"]))
+    params, trainer, batch = _setup(args["cfg"])
+    state = trainer.init_state(batch)
+    state, m = trainer.step(state, batch, rng=jax.random.PRNGKey(0))
+    jax.block_until_ready(m["loss"])  # compile outside the timed region
+    saver = AsyncCheckpointer(params.distributed_barrier_timeout_s) \
+        if args["use_async"] else None
+    steps = args["steps"]
+    cadence = args["cadence"]
+    times = []
+    step_no = 7
+    for i in range(steps):
+        t0 = time.monotonic()
+        state, metrics = trainer.step(state, batch,
+                                      rng=jax.random.PRNGKey(1 + i))
+        jax.block_until_ready(metrics["loss"])
+        if (i + 1) % cadence == 0:
+            step_no += 1
+            if saver is not None:
+                saver.submit(params.model_path, step_no, state.variables,
+                             state.opt_state, max_keep=1)
+            else:
+                ckpt.save(params.model_path, step_no, state.variables,
+                          state.opt_state, 1)
+        times.append(time.monotonic() - t0)
+    if saver is not None:
+        saver.close()
+    plain = [t for i, t in enumerate(times) if (i + 1) % cadence]
+    cad = [t for i, t in enumerate(times) if not (i + 1) % cadence]
+    if jax.process_index() == 0:
+        print("OVERLAP " + json.dumps({
+            "plain_median": float(np.median(plain)),
+            "cadence_median": float(np.median(cad)),
+            "plain": plain, "cadence": cad}), flush=True)
+    # the checkpoint must actually have committed
+    from homebrewnlp_tpu.train.checkpoint import list_checkpoints
+    assert list_checkpoints(params.model_path), "no checkpoint committed"
+
+
+def _mode_faultsave(args: dict) -> None:
+    import jax
+    import numpy as np
+
+    from homebrewnlp_tpu.distributed import bootstrap
+    from homebrewnlp_tpu.distributed.async_checkpoint import (
+        AsyncCheckpointer, AsyncSaveError)
+    from homebrewnlp_tpu.train import checkpoint as ckpt
+    from homebrewnlp_tpu.utils.fault_injection import FaultInjectionFS
+
+    pid = jax.process_index()
+    store = DiskStoreFS(args["store"])
+    recorder = FaultInjectionFS(inner=store)  # no faults: records op schedule
+    fslib.register("dstore", recorder)
+    params, trainer, batch = _setup(args["cfg"])
+    state = trainer.init_state(batch)
+
+    saver = AsyncCheckpointer(params.distributed_barrier_timeout_s)
+    saver.submit(params.model_path, 5, state.variables, state.opt_state,
+                 max_keep=3)
+    saver.flush()
+    good_ops = list(recorder.ops)
+    # this process's manifest write: crashing exactly THERE is "between
+    # shard write and manifest commit" — every shard file of save #2 is
+    # on disk, its shards_<pid>.json (and therefore the chief's rename)
+    # never happens
+    manifest_idx = [i for i, (op, key) in enumerate(good_ops)
+                    if op == "write" and key.endswith(f"shards_{pid}.json")]
+    assert manifest_idx, good_ops
+
+    if pid == 1:
+        fslib.register("dstore", FaultInjectionFS(
+            inner=store, crash_at=manifest_idx[0]))
+    else:
+        fslib.register("dstore", store)
+    state, _ = trainer.step(state, batch, rng=jax.random.PRNGKey(100))
+    failed = False
+    try:
+        saver.submit(params.model_path, 9, state.variables, state.opt_state,
+                     max_keep=3)
+        saver.flush()
+    except (AsyncSaveError, TimeoutError) as e:
+        # pid 1: the injected crash; pid 0: commit-barrier timeout because
+        # its peer died mid-protocol — BOTH must fail loudly
+        failed = True
+        print(f"worker {pid}: save 9 failed as injected: "
+              f"{type(e).__name__}", flush=True)
+    assert failed, "torn save did not surface"
+
+    fslib.register("dstore", store)  # storage 'recovers'
+    bootstrap.barrier("post_fault_sync", 60.0)
+    steps = ckpt.list_checkpoints(params.model_path)
+    assert steps == [5], f"torn save must stay invisible, saw {steps}"
+    restored = ckpt.restore_latest_valid(params.model_path, strict=True)
+    assert restored is not None and restored[2] == 5
+    # the fallback state is usable: one live step from it
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.core import sharding as shardlib
+    from homebrewnlp_tpu.train import TrainState
+    st = TrainState(
+        shardlib.place_tree(state.variables, {
+            k: np.asarray(v).astype(state.variables[k].dtype)
+            for k, v in restored[0].items()}),
+        shardlib.place_tree(state.opt_state, restored[1]),
+        jnp.asarray(restored[2], jnp.int32))
+    _, metrics = trainer.step(st, batch, rng=jax.random.PRNGKey(300))
+    assert np.isfinite(float(np.asarray(jax.device_get(metrics["loss"]))))
+    print(f"FAULTSAVE OK p{pid}", flush=True)
+
+
+MODES = {"lockstep": _mode_lockstep, "save": _mode_save,
+         "restore": _mode_restore, "overlap": _mode_overlap,
+         "faultsave": _mode_faultsave}
+
+
+def main() -> int:
+    port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    mode, args = sys.argv[4], json.loads(sys.argv[5])
+    if nproc > 1:
+        os.environ["HBNLP_COORDINATOR"] = f"localhost:{port}"
+        os.environ["HBNLP_NUM_PROCESSES"] = str(nproc)
+        os.environ["HBNLP_PROCESS_ID"] = str(pid)
+        from homebrewnlp_tpu.distributed import bootstrap
+        assert bootstrap.maybe_initialize()
+    MODES[mode](args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,10 +14,16 @@ import sys
 def main() -> int:
     port, pid, nproc, cfg_path = (int(sys.argv[1]), int(sys.argv[2]),
                                   int(sys.argv[3]), sys.argv[4])
-    import jax
-    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
-                               process_id=pid)
+    import os
+    os.environ["HBNLP_COORDINATOR"] = f"localhost:{port}"
+    os.environ["HBNLP_NUM_PROCESSES"] = str(nproc)
+    os.environ["HBNLP_PROCESS_ID"] = str(pid)
     sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    # the real bootstrap: explicit-flag discovery + gloo CPU collectives
+    # (XLA's default CPU client refuses multi-process computations)
+    from homebrewnlp_tpu.distributed import bootstrap
+    assert bootstrap.maybe_initialize()
+    import jax
     from homebrewnlp_tpu.config import ModelParameter
     from homebrewnlp_tpu.run.train_loop import train
 

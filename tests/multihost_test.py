@@ -8,7 +8,6 @@ the rebuild's equivalent of the reference's per-host infeed placement
 """
 import os
 import re
-import socket
 import subprocess
 import sys
 
@@ -18,9 +17,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+    from homebrewnlp_tpu.distributed.bootstrap import free_port
+    return free_port()
 
 
 
