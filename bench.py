@@ -15,6 +15,7 @@ sized for one chip (the reference ran batch 1024 across a 32-core pod =
 (BASELINE.md), so vs_baseline is tracked against the first recorded run of
 this benchmark (BENCH_BASELINE.json), giving round-over-round progress.
 """
+import argparse
 import json
 import os
 import sys
@@ -22,6 +23,12 @@ import time
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_BASELINE.json")
+
+#: ``--check``: the measured headline may drop at most this fraction below
+#: the committed per-backend floor before the gate fails (same banding idea
+#: as the cost-ledger tolerance: run-to-run noise on shared rigs is real,
+#: a structural regression is much larger)
+CHECK_TOLERANCE = 0.10
 
 BENCH_CONFIG = {
     "model_mode": "gpt", "use_video": False, "use_language": True,
@@ -76,8 +83,130 @@ def _ensure_live_backend():
     os.environ["_BENCH_BACKEND_CHECKED"] = "1"
 
 
-def main() -> int:
+def compile_probe(steps: int = 2) -> dict:
+    """Cold-vs-warm setup+compile with the persistent compilation cache
+    (``compile_cache_dir``, ROADMAP item 4's measurement half).
+
+    Runs the flagship build+warmup twice in FRESH subprocesses sharing one
+    cache directory: the first pays the real XLA compile (cold), the
+    second should hit the persistent cache (warm).  In-process re-builds
+    would hit jax's in-memory cache and prove nothing about restarts —
+    the tax this knob exists to kill is the ~100s compile on every
+    run_manager relaunch / preemption resume / bench round."""
+    import subprocess
+    import tempfile
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="bench_compile_cache_") as cache:
+        prog = (
+            "import json, sys, time, os\n"
+            "t0 = time.monotonic()\n"
+            "import numpy as np, jax, jax.numpy as jnp\n"
+            "from homebrewnlp_tpu.config import ModelParameter\n"
+            "from homebrewnlp_tpu.model import Model\n"
+            "from homebrewnlp_tpu.train import Trainer\n"
+            "from homebrewnlp_tpu.utils.compile_cache import \\\n"
+            "    install_compile_cache\n"
+            "import bench\n"
+            "cfg = dict(bench.BENCH_CONFIG)\n"
+            "if jax.default_backend() == 'cpu':\n"
+            "    cfg.update(sequence_length=64, features_per_head=64,\n"
+            "               depth=4, train_batch_size=8)\n"
+            f"cfg['compile_cache_dir'] = {cache!r}\n"
+            "params = ModelParameter(cfg)\n"
+            "install_compile_cache(params)\n"
+            "model = Model(params)\n"
+            "trainer = Trainer(params, model)\n"
+            "rng = np.random.default_rng(0)\n"
+            "x = rng.integers(0, params.vocab_size,\n"
+            "                 (params.train_batch_size,\n"
+            "                  params.sequence_length, 1))\n"
+            "batch = {'token_x': jnp.asarray(x),\n"
+            "         'token_y': jnp.asarray((x + 1) % params.vocab_size)}\n"
+            "state = trainer.init_state(batch)\n"
+            "t1 = time.monotonic()\n"
+            f"for _ in range({steps}):\n"
+            "    state, metrics = trainer.step(state, batch)\n"
+            "float(metrics['loss'])\n"
+            "t2 = time.monotonic()\n"
+            "print(json.dumps({'setup_s': round(t1 - t0, 2),\n"
+            "                  'compile_warmup_s': round(t2 - t1, 2),\n"
+            "                  'total_s': round(t2 - t0, 2)}))\n")
+        for phase in ("cold", "warm"):
+            env = dict(os.environ, _BENCH_BACKEND_CHECKED="1")
+            res = subprocess.run(
+                [sys.executable, "-c", prog],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=1800, env=env)
+            if res.returncode != 0:
+                # measured round-11 finding on the CPU rig: the COLD run
+                # populates the cache fine, but jax-0.4.37's CPU backend
+                # corrupts the heap DESERIALIZING the cached executables on
+                # the warm relaunch (SIGSEGV/SIGABRT, "corrupted size vs.
+                # prev_size"; minimal pure-jax programs reload fine, the
+                # train-step mix does not).  Same environment-gap class as
+                # the pallas interpret / PartitionId gaps: report the
+                # evidence instead of dying, so the probe still lands the
+                # verdict in BASELINE.md and a capable env measures the
+                # real delta
+                out[phase] = {
+                    "crashed": True, "returncode": res.returncode,
+                    "classified": "jax-0.4.37 cpu persistent-cache "
+                                  "deserialization heap corruption "
+                                  "(environment gap; docs/PERFORMANCE.md "
+                                  "'Round 11')",
+                    "stderr_tail": res.stderr[-300:].strip()}
+                continue
+            out[phase] = json.loads(res.stdout.strip().splitlines()[-1])
+    if not (out["cold"].get("crashed") or out["warm"].get("crashed")):
+        out["compile_speedup"] = round(
+            out["cold"]["compile_warmup_s"]
+            / max(out["warm"]["compile_warmup_s"], 1e-9), 2)
+    return out
+
+
+def check_floor(value: float, backend: str) -> int:
+    """``--check``: nonzero when the measured headline tokens/sec/chip
+    falls below the committed per-backend floor minus the tolerance band
+    (BENCH_BASELINE.json ``floor`` keys; mirrors ``bench_serving.py
+    --check``).  No committed floor for this backend = loud failure, not a
+    vacuous pass."""
+    try:
+        with open(BASELINE_FILE) as f:
+            baselines = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"--check: cannot read {BASELINE_FILE}: {exc}",
+              file=sys.stderr)
+        return 1
+    floor = (baselines.get(backend) or {}).get("floor")
+    if not floor:
+        print(f"--check: no committed floor for backend {backend!r} in "
+              f"{BASELINE_FILE} — commit one from a healthy run",
+              file=sys.stderr)
+        return 1
+    limit = float(floor) * (1.0 - CHECK_TOLERANCE)
+    verdict = "PASS" if value >= limit else "FAIL"
+    print(f"--check [{verdict}]: {value:.0f} tokens/sec/chip vs floor "
+          f"{float(floor):.0f} (-{CHECK_TOLERANCE:.0%} band = {limit:.0f}, "
+          f"backend {backend})", file=sys.stderr)
+    return 0 if value >= limit else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero when the flagship tokens/sec/chip "
+                         "drops below the committed floor "
+                         "(BENCH_BASELINE.json, tolerance-banded) — the "
+                         "headline-perf regression gate")
+    ap.add_argument("--compile-probe", action="store_true",
+                    help="measure cold-vs-warm setup+compile with the "
+                         "persistent compilation cache in two fresh "
+                         "subprocesses, print the JSON, and exit")
+    args = ap.parse_args(argv)
     _ensure_live_backend()
+    if args.compile_probe:
+        print(json.dumps({"compile_probe": compile_probe()}), flush=True)
+        return 0
     import numpy as np
     t_setup = time.monotonic()
     import jax
@@ -308,6 +437,11 @@ def main() -> int:
     # consumer taking the last JSON line sees the enriched line when the
     # companion succeeds and this one when it dies
     print(json.dumps(out), flush=True)
+
+    if args.check:
+        # gate mode: the verdict is about the headline number only — skip
+        # the companion benches so a CI gate pays one build, not five
+        return check_floor(tokens_per_sec_chip, backend)
 
     def companion(label: str, prefix: str, run_fn, keys=(),
                   value_key: str = "value",

@@ -270,6 +270,49 @@ def dtype_promotion_audit(entry: str, hlo_text: str,
     return []
 
 
+_INT8_CONVERT_RE = re.compile(
+    r"=\s*(?:f32|bf16|f16)\[[0-9,]*\](?:\{[^}]*\})?\s+convert\(\s*"
+    r"s8\[[0-9,]*\]")
+
+
+def int8_promotion_audit(entry: str, hlo_text: str,
+                         scopes: typing.Collection[str] = ("dequant",
+                                                           "cache_read")
+                         ) -> typing.List[Finding]:
+    """Every float ``convert`` of an int8 operand must belong to a named
+    dequant scope.
+
+    The quantized paths promise int8 reaches float exactly once, inside a
+    named fused-dequant region: weights (``serve_quantized_weights``,
+    ``train_quantized_matmuls``) under ``named_scope("dequant")``
+    (``core.scope.materialize_param`` / ``core.quant.ste_dequantize``),
+    and int8 KV caches (``decode_cache_dtype: "int8"``) under the decode
+    path's ``named_scope("cache_read")`` (model/decode.py) — both are
+    allowed by default.  Any OTHER s8 -> float convert is an accidental
+    full-precision materialization of a quantized buffer: it silently
+    costs the float copy's HBM and hides the bandwidth saving the knobs
+    exist for.  An instruction qualifies when its ``op_name`` metadata
+    path contains one of ``scopes``."""
+    offenders = []
+    for line in hlo_text.splitlines():
+        if _INT8_CONVERT_RE.search(line) is None:
+            continue
+        op = _OP_NAME_IN_LINE_RE.search(line)
+        path = op.group(1) if op else ""
+        if not any(s in path for s in scopes):
+            offenders.append(line.strip())
+    if offenders:
+        return [Finding("int8-promotion", entry,
+                        f"{len(offenders)} float convert(s) of int8 "
+                        "operands outside the fused dequant scope "
+                        "(quantized weights silently re-materialized in "
+                        "full precision):\n" + "\n".join(offenders[:8]))]
+    return []
+
+
+_OP_NAME_IN_LINE_RE = re.compile(r'op_name="([^"]+)"')
+
+
 def dims_of(shape_string: str) -> str:
     """``"bf16[512,512]"`` -> ``"512,512"`` (idempotent on bare dims)."""
     m = re.search(r"\[([0-9,]*)\]", shape_string)
@@ -480,4 +523,9 @@ def audit(entry: str, hlo_text: str, *,
             entry, collective_census(hlo_text), budget)
     if check_host_sync:
         findings += host_sync_audit(entry, hlo_text)
+    # always on: vacuously clean on int8-free modules, and the quantized
+    # paths (serve_quantized_weights / train_quantized_matmuls) get their
+    # no-promotion-outside-dequant invariant audited for free the moment
+    # an entry point compiles with int8 weights
+    findings += int8_promotion_audit(entry, hlo_text)
     return findings

@@ -251,8 +251,56 @@ class ModelParameter:
         # True/False, or "auto" (default): enable attention-output stashing
         # when the sequence is long enough to pay and the stash fits a small
         # HBM fraction (model/blocks.py resolve_stash) — the measured 16k/32k
-        # recipes then need no explicit flag
+        # recipes then need no explicit flag.
+        # DEPRECATED ALIAS (PR 11): an explicit true/false here maps onto
+        # remat_policy "stash"/"recompute" when remat_policy is "auto"; the
+        # policy layer below is the real knob
         self.stash_attention_outputs = "auto"
+        # ---- measured remat policy (model/remat.py, docs/PERFORMANCE.md
+        # 'Round 11').  What the revnet/momentum backward does about
+        # re-materializing block interiors:
+        #   "recompute"  — the strategy custom_vjp re-runs each block's
+        #                  forward inside jax.vjp (O(1) activation memory;
+        #                  the historical default behavior),
+        #   "stash"      — recompute, but each flash/ring attention layer's
+        #                  (out, lse) rides the strategy residuals so the
+        #                  backward replay runs no forward attention kernels
+        #                  (the old stash_attention_outputs=true),
+        #   "save"       — NO custom_vjp: the plain recurrence under native
+        #                  scan AD, every linearization residual saved
+        #                  (zero recompute, O(depth) residual memory),
+        #   "save_dots"  — "save" with each block under jax.checkpoint
+        #                  (policy dots_saveable): GEMM outputs saved,
+        #                  elementwise recomputed — the middle ground for
+        #                  compute-bound chips with spare HBM,
+        #   "auto"       — the old stash auto rule (stash when long-context
+        #                  pays and fits, else recompute); the save modes
+        #                  are measured opt-ins — the round-11 A/B lost on
+        #                  the hbm-bound rig and model/remat.py documents
+        #                  the analytic comparison (remat_report) for
+        #                  chips where it could win.
+        # All four execute the SAME primal recurrence (identical losses;
+        # gradients agree to reconstruction ulps — tests/remat_policy_test).
+        self.remat_policy = "auto"
+        # matmul accumulation policy for bf16 GEMMs ("auto"/"f32"/"bf16"):
+        # "auto" keeps the established behavior (f32 MXU accumulation
+        # requested on TPU backends, backend default elsewhere); "bf16"
+        # drops the f32 request — faster MXU path whose quality cost must
+        # clear the same harness as train_quantized_matmuls; "f32" insists
+        # where supported (CPU keeps backend default — its DotThunk cannot
+        # emit mixed bf16->f32 dots).  Consumed by core/tensor.einsum via
+        # the scope context.
+        self.matmul_accumulation = "auto"
+        # quantize the training forward's largest GEMM weights to int8 each
+        # step (core/quant.py quantize_for_training): one on-device amax
+        # pass over the live master weights, the forward reads the
+        # depth-shared per-channel int8 grid through a straight-through-
+        # estimator dequant (masters/optimizer stay full precision).
+        # Quality-guarded like serve_quantized_weights: losses bit-identical
+        # when off; >= 99% argmax agreement + in-noise val loss when on
+        # (tests/train_quant_test.py); graft-lint audits that the step emits
+        # no float promotion of int8 operands outside the fused dequant
+        self.train_quantized_matmuls = False
         # lax.scan unroll factor for the depth scan (XLA overlap vs memory)
         self.scan_unroll = 1
         self.gradient_checkpointing_policy = "nothing_saveable"
@@ -371,6 +419,13 @@ class ModelParameter:
         # telemetry_enabled — profiling has no per-step cost until triggered
         self.telemetry_profile_on_signal = False
         self.telemetry_profile_steps = 10
+        # overlap the next batch's host->device transfer with the running
+        # device step (run/train_loop.py _AsyncFeeder): the loop starts a
+        # device_put / multi-host shard placement for batch N+1 right after
+        # dispatching step N, so the step-phase spans' data_wait/dispatch
+        # no longer serialize host transfer against device compute.  Off =
+        # the historical fetch-then-dispatch ordering
+        self.async_input_transfer = True
         # ---- multi-host runtime (docs/DISTRIBUTED.md) ----
         # route checkpoint saves (cadence AND emergency) through the
         # double-buffered background saver: the step thread pays only the
@@ -468,6 +523,26 @@ class ModelParameter:
             raise ValueError("stash_attention_outputs must be true, false, "
                              f"or \"auto\", got "
                              f"{self.stash_attention_outputs!r}")
+        if self.remat_policy not in ("auto", "recompute", "stash", "save",
+                                     "save_dots"):
+            raise ValueError("remat_policy must be \"auto\", \"recompute\", "
+                             "\"stash\", \"save\" or \"save_dots\", got "
+                             f"{self.remat_policy!r}")
+        if self.matmul_accumulation not in ("auto", "f32", "bf16"):
+            raise ValueError("matmul_accumulation must be \"auto\", \"f32\" "
+                             f"or \"bf16\", got "
+                             f"{self.matmul_accumulation!r}")
+        # the checkpoint-strategy jax.checkpoint sites consume this name
+        # via getattr (model/blocks.py _checkpoint_policy); validate here so
+        # a typo is a clear config error, not an AttributeError mid-trace
+        import jax
+        if not hasattr(jax.checkpoint_policies,
+                       self.gradient_checkpointing_policy):
+            raise ValueError(
+                "gradient_checkpointing_policy must name a "
+                "jax.checkpoint_policies member (e.g. \"nothing_saveable\", "
+                f"\"dots_saveable\"), got "
+                f"{self.gradient_checkpointing_policy!r}")
         if isinstance(self.position_embedding, str):
             self.position_embedding = self.position_embedding.split('-')
         if isinstance(self.token_embedding, str):

@@ -75,6 +75,10 @@ class Context:
         # where no lax.scan/custom_vjp separates the layer trace from the
         # consumer — ReplayBlock propagates it into its per-block contexts.
         self.stats_sink: typing.Optional[list] = None
+        # matmul-accumulation policy for bf16 einsums ("auto"/"f32"/"bf16",
+        # config.matmul_accumulation); consumed by core.tensor.einsum and
+        # propagated by ReplayBlock like quant_scales
+        self.matmul_accumulation: typing.Optional[str] = None
         self._rng_count = 0
 
     # -- naming ------------------------------------------------------------
@@ -204,6 +208,11 @@ def materialize_param(ctx: Context, name: str, data, calc_dtype):
     weights."""
     scales = getattr(ctx, "quant_scales", None)
     if scales and data.dtype == jnp.int8 and name in scales:
-        scaled = data.astype(jnp.float32) * scales[name]
-        return scaled.astype(calc_dtype)
+        # named region: graft-lint's int8-promotion audit allows s8->float
+        # converts ONLY inside dequant-tagged scopes (hlo_lint.py), so the
+        # serving dequant must carry the same tag the training-side
+        # ste_dequantize does (core/quant.py)
+        with jax.named_scope("dequant"):
+            scaled = data.astype(jnp.float32) * scales[name]
+            return scaled.astype(calc_dtype)
     return data.astype(calc_dtype)

@@ -1,134 +1,14 @@
-"""Weight-only int8 quantization for serving (decode matvec bandwidth).
+"""Weight-only int8 quantization — import shim.
 
-Batch-1 decode is weight-READ bound: every generated token streams the
-full parameter set through the MXU once (~0.85 ms for the flagship's 342M
-bf16 weights at v5e HBM bandwidth, docs/PERFORMANCE.md 'Decoding').
-Storing the large matmul weights as int8 halves the bytes per step; the
-dequantize (convert + scalar multiply) fuses into the XLA dot's operand
-read, so HBM traffic drops without a separate dequant pass.  KV-cache
-int8 quantization (model/decode.py) is orthogonal — this file quantizes
-the WEIGHTS.
-
-Granularity: per-channel symmetric scales over every axis the consuming
-einsum does NOT contract, when the contracted dims are known
-(``Model.param_fan_in``, recorded at init from each linear's fan-in
-hint); per-last-axis otherwise (parameters are laid out ``old + new``, so
-the last axis is always an output dim).  Sibling depths of a block config
-share ONE scale (joint amax): the scan-over-layers replay resolves every
-depth under the depth-0 canonical names, so per-depth scales would
-silently apply depth-0's channel pattern to all depths (tests pin
-scan/unrolled loss equality).  Measured on a TRAINED 1000-step checkpoint
-(the MoE mixer, loss 1.41 on held-out text): per-tensor scales degrade
-teacher-forcing argmax agreement to 73% / loss +0.59; depth-shared
-per-channel scales measure **99.3% agreement with the loss unchanged to
-four decimals** — at 2.31 → 1.38 ms/token decode (with int8 caches) at
-the flagship.  The scale arrays broadcast through the same
-``materialize_param`` multiply a scalar would.
-
-Opt-in: config ``serve_quantized_weights: true`` — run/modes serving
-paths and the InterfaceWrapper apply it at model-load time.  Embeddings
-and sub-threshold tensors stay in storage dtype (gathers are not the
-bandwidth term; tiny tensors round badly for nothing).
-
-Reference parity note: the reference serves full-precision only
-(/root/reference/src/run/inference.py); this is a beyond-reference
-capability measured in BASELINE.md 'Decoding'.
+The implementation moved to ``core/quant.py`` when PR 11 promoted the
+int8 weight path into training (``train_quantized_matmuls``): the
+eligibility rules, scale-axis selection and ``quantize_variables`` are
+shared between the serving load-time path and the in-step training path,
+so they live next to the ``core.scope.materialize_param`` seam that
+consumes the scales.  This module keeps the historical import surface
+(``homebrewnlp_tpu.infer.quant``) working unchanged.
 """
 from __future__ import annotations
 
-import typing
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-# quantize only tensors with at least this many elements AND >= 2 dims:
-# the big matmul weights are the bandwidth term; norms/biases/rezero
-# scalars are noise (and most are accuracy-sensitive)
-MIN_QUANT_SIZE = 1 << 16
-
-
-def eligible(name: str, value, dims) -> bool:
-    if np.ndim(value) < 2 or np.size(value) < MIN_QUANT_SIZE:
-        return False
-    # embeddings feed gathers (position embeddings) or the output logits
-    # head; the logits matmul IS bandwidth-heavy but its quantization error
-    # lands directly on the sampled distribution — keep full precision
-    # (measured: the decode step is dominated by the body matvecs)
-    return "embed" not in name
-
-
-def _scale_axes(dims, fan_in_names, ndim: int) -> typing.Tuple[int, ...]:
-    """Axes the amax reduces over — i.e. where a single scale must cover the
-    whole axis.  A per-channel scale is only sound along axes the consuming
-    einsum does NOT contract (it must commute out of the sum), so reduce
-    exactly over the recorded fan-in (contracted) axes.  Fall back to
-    everything-but-last when the fan-in record is missing or degenerate
-    (keeps the scale array a negligible fraction of the weight)."""
-    if dims and fan_in_names:
-        contracted = tuple(i for i, d in enumerate(dims)
-                           if d.name in fan_in_names)
-        n_contracted = 1
-        for i in contracted:
-            n_contracted *= dims[i].size
-        if contracted and n_contracted >= 64:
-            return contracted
-    # fallback: per-channel along the last axis only.  Finer schemes were
-    # measured WORSE on a trained MoE checkpoint (docstring): per-(channel,
-    # expert) scales on the 4-dim expert weights dropped teacher-forcing
-    # agreement 91% → 85% despite being mathematically commutable — the
-    # per-expert amax acts as mild smoothing the finer grid loses
-    return tuple(range(ndim - 1))
-
-
-def quantize_variables(variables: typing.Dict[str, typing.Any],
-                       param_dims: typing.Optional[dict] = None,
-                       param_fan_in: typing.Optional[dict] = None
-                       ) -> typing.Tuple[typing.Dict[str, jax.Array],
-                                         typing.Dict[str, jax.Array]]:
-    """(quantized variables, scales): eligible weights become int8 arrays
-    with per-channel f32 scales such that ``w ≈ w_q * scale``; everything
-    else passes through unchanged.  ``param_fan_in`` (Model.param_fan_in)
-    names each weight's contracted dims so the scales can be per-channel
-    over EVERY non-contracted axis — per-expert × per-column for MoE
-    weights, not just per-last-axis."""
-    from ..model.backend import _BLOCK_RE
-
-    def canonical(name: str) -> str:
-        return _BLOCK_RE.sub(
-            lambda m: f"{m.group(1)}block0_{m.group(3)}_{m.group(4)}/", name)
-
-    qvars: typing.Dict[str, jax.Array] = {}
-    scales: typing.Dict[str, jax.Array] = {}
-    # sibling depths of one block config share ONE scale array (joint amax
-    # over the group): the scan-over-layers replay resolves every depth's
-    # parameters under the depth-0 canonical name, so a per-depth scale
-    # keyed by full name would silently apply depth-0's channel pattern to
-    # every depth (scan) while the unrolled path used per-depth scales —
-    # shared scales make both paths read the same, correct, array.  The
-    # scales dict carries each group's array under every member name AND
-    # the canonical name
-    groups: typing.Dict[str, list] = {}
-    for name, value in variables.items():
-        dims = (param_dims or {}).get(name, ())
-        if not eligible(name, value, dims):
-            qvars[name] = value
-            continue
-        groups.setdefault(canonical(name), []).append(name)
-    for canon, names in groups.items():
-        dims = (param_dims or {}).get(names[0], ())
-        axes = _scale_axes(dims, (param_fan_in or {}).get(names[0], ()),
-                           np.ndim(variables[names[0]]))
-        amax = None
-        for name in names:
-            a = jnp.max(jnp.abs(jnp.asarray(variables[name], jnp.float32)),
-                        axis=axes, keepdims=True)
-            amax = a if amax is None else jnp.maximum(amax, a)
-        scale = (jnp.maximum(amax, 1e-30) / 127.0).astype(jnp.float32)
-        for name in names:
-            w = jnp.asarray(variables[name], jnp.float32)
-            qvars[name] = jnp.clip(jnp.round(w / scale), -127,
-                                   127).astype(jnp.int8)
-            scales[name] = scale
-        scales[canon] = scale
-    return qvars, scales
+from ..core.quant import (MIN_QUANT_SIZE, _scale_axes, eligible,  # noqa: F401
+                          quantize_variables)

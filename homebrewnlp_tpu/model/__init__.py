@@ -297,6 +297,7 @@ class Model:
         assert self.plan is not None, "call init() first (or assign .plan)"
         ctx = scope.Context("apply", params=variables, rng_key=rng, mesh=mesh)
         ctx.quant_scales = getattr(self, "quant_scales", None)
+        ctx.matmul_accumulation = self.params.matmul_accumulation
         ctx.stats_sink = stats_sink
         with scope.context(ctx):
             args = self._named_inputs(batch)
@@ -441,6 +442,7 @@ class Model:
                             cache_dtype=p.decode_cache_dtype, model_params=p)
         ctx = scope.Context("apply", params=variables, mesh=mesh, decode=state)
         ctx.quant_scales = getattr(self, "quant_scales", None)
+        ctx.matmul_accumulation = p.matmul_accumulation
         decode_dims = [Dim(d.name, 1) if d.name == p.sequence_dim.name else d
                        for d in p.token_dim_shape]
         with scope.context(ctx):
@@ -478,6 +480,7 @@ class Model:
                              cache_dtype=p.decode_cache_dtype, model_params=p)
         ctx = scope.Context("apply", params=variables, mesh=mesh)
         ctx.quant_scales = getattr(self, "quant_scales", None)
+        ctx.matmul_accumulation = p.matmul_accumulation
         ctx.prefill = state
 
         def _output_blocks(params, out):

@@ -11,23 +11,34 @@ import numpy as np
 
 from ..config import BlockArgs
 from ..core import scope
-from ..core.tensor import (NamedTensor, einsum, multiply, sigmoid as _sigmoid,
+from ..core.tensor import (NamedTensor, multiply, sigmoid as _sigmoid,
                            softplus, tanh as _tanh, unary)
 import jax
 import jax.numpy as jnp
 
 
 def _gelu(args: BlockArgs) -> NamedTensor:
-    """tanh-approx gelu, exactly the reference's einsum formulation
-    (activation.py:158-161)."""
+    """tanh-approx gelu — the reference's formula (activation.py:158-161),
+    as ONE fused scalar expression.
+
+    The historical spelling built the cubic and the final product through
+    ``einsum([x, x, x, const])`` with NamedTensor scalar constants; on the
+    profiled flagship step each constant materialised as a full
+    activation-shaped broadcast instruction with multiple fusion users
+    (~4% of step time pure broadcast traffic — docs/PERFORMANCE.md 'Round
+    11').  The single jnp expression keeps every constant scalar inside
+    one fusion.  Same formula and dtype; product association differs by
+    <= 1 bf16 ulp (step-loss parity to 4 decimals verified in the round-11
+    A/B; tests/basic_pointwise_test.py pins the closed form)."""
     x = args.tensor
-    inner = einsum([x, x, x, __const(x, 0.044715)], x.dims) + x * np.sqrt(2 / np.pi)
-    return einsum([x, _tanh(inner) + 1.0, __const(x, 0.5)], x.dims)
 
-
-def __const(like: NamedTensor, value: float) -> NamedTensor:
-    from ..core.tensor import constant
-    return constant(value, like.dtype)
+    def f(v):
+        c = np.float32(0.044715).astype(v.dtype)
+        s = np.float32(np.sqrt(2 / np.pi)).astype(v.dtype)
+        inner = v * v * v * c + v * s
+        return v * (jnp.tanh(inner) + np.float32(1).astype(v.dtype)) \
+            * np.float32(0.5).astype(v.dtype)
+    return unary(f, x)
 
 
 def _relu(args):

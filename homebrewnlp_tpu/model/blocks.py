@@ -66,6 +66,7 @@ class ReplayBlock:
         outer_prefill = None
         outer_sink = None
         outer_quant = None
+        outer_acc = None
         if scope.in_context():
             outer_rng = scope.current().rng_key
             outer_mesh = scope.current().mesh
@@ -73,6 +74,7 @@ class ReplayBlock:
             outer_prefill = scope.current().prefill
             outer_sink = scope.current().stats_sink
             outer_quant = getattr(scope.current(), "quant_scales", None)
+            outer_acc = getattr(scope.current(), "matmul_accumulation", None)
         ctx = scope.Context("apply", params=subset, rng_key=None,
                             mesh=outer_mesh, decode=outer_decode)
         ctx.prefill = outer_prefill
@@ -82,6 +84,7 @@ class ReplayBlock:
         # scan/decode/prefill paths, i.e. every real serving path) would
         # consume raw -127..127 integers
         ctx.quant_scales = outer_quant
+        ctx.matmul_accumulation = outer_acc
         # attention-output stash channel (collect/provide), handed EXPLICITLY
         # by the strategy code — never inherited from the outer context, so
         # a mode can't leak across custom_vjp replay boundaries
@@ -391,8 +394,16 @@ def _mom_scan_bwd(fns, alpha, unroll, stash, res, cot):
 momentum_scan.defvjp(_mom_scan_fwd, _mom_scan_bwd)
 
 
+def _checkpoint_policy(params: ModelParameter):
+    """The named ``jax.checkpoint`` policy for the 'checkpoint' strategy
+    (``gradient_checkpointing_policy``; the default "nothing_saveable" is
+    jax.checkpoint's own default, so reference configs are unchanged)."""
+    return getattr(jax.checkpoint_policies,
+                   params.gradient_checkpointing_policy)
+
+
 def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool,
-                unroll: int = 1):
+                unroll: int = 1, ckpt_policy=None):
     """Scanned 'checkpoint' / 'none' strategies: O(depth) carries saved by
     scan AD; with use_checkpoint each block recomputes its interior."""
     def step(carry, sl):
@@ -400,7 +411,8 @@ def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool,
         for f, stk, shr in zip(fns, sl, shared):
             if use_checkpoint:
                 x = jax.checkpoint(
-                    lambda sub, x_, it_, f_=f: f_(sub, x_, it=it_)
+                    lambda sub, x_, it_, f_=f: f_(sub, x_, it=it_),
+                    policy=ckpt_policy,
                 )({**stk, **shr}, x, it)
             else:
                 x = f({**stk, **shr}, x, it=it)
@@ -408,6 +420,37 @@ def _plain_scan(fns, stacked, shared, x, use_checkpoint: bool,
 
     (x, _), _ = jax.lax.scan(step, (x, jnp.int32(0)), stacked, unroll=unroll)
     return x
+
+
+def _strategy_scan_save(params: ModelParameter, fns, stacked, shared, src,
+                        strategy: str, policy: str):
+    """The 'save'/'save_dots' remat policies over the scanned stack: the
+    IDENTICAL revnet/momentum primal recurrence, WITHOUT the custom_vjp
+    wrapper — native scan AD saves the linearization residuals (stacked
+    over depth) instead of re-running each block's forward in the
+    backward.  'save_dots' additionally wraps every block in
+    ``jax.checkpoint(policy=dots_saveable)`` so only GEMM outputs are
+    saved and elementwise work is recomputed (model/remat.py)."""
+    from .remat import block_caller
+    call = block_caller(policy)
+    alpha = params.momentumnet_alpha
+
+    def step(carry, sl):
+        if strategy == "revnet":
+            x1, x2, it = carry
+            for c, f in enumerate(fns):
+                x1, x2 = x2, x1 + call(f, {**sl[c], **shared[c]}, x2, it)
+            return (x1, x2, it + 1), None
+        x, v, it = carry
+        for c, f in enumerate(fns):
+            v = v * alpha + call(f, {**sl[c], **shared[c]}, x, it) \
+                * (1 - alpha)
+            x = x + v
+        return (x, v, it + 1), None
+
+    (a, b, _), _ = jax.lax.scan(step, (src, src, jnp.int32(0)), stacked,
+                                unroll=params.scan_unroll)
+    return a + b
 
 
 def _plan_scan(params: ModelParameter,
@@ -487,41 +530,15 @@ def _scan_prologue(params: ModelParameter, ctx, plan, src: NamedTensor,
 
 
 def resolve_stash(params: ModelParameter, mesh=None) -> bool:
-    """``stash_attention_outputs``: True/False pass through; ``"auto"``
-    (the default) enables stashing when it measurably pays AND fits.
-
-    Stashing trades HBM residents (each attention layer's (out, lse) rides
-    the strategy custom_vjp residuals) for skipping the flash forward
-    kernel in the revnet/momentum backward recompute — +23% at 16k ctx
-    (docs/PERFORMANCE.md).  Worth it only when the attention forward is
-    expensive (long sequences; the kernels engage at seq % 128 == 0
-    anyway) and the PER-DEVICE stash is a small fraction of HBM: the
-    (out [b,s,h,d], lse [b,h,s]) arrays shard over every data/model/
-    sequence mesh axis, so the global estimate divides by the mesh size,
-    and the HBM figure comes from the mesh's own devices (an AOT lowering
-    for a pod budgets against the pod's chips, not the local client).
-    Sized conservatively as if every block held one attention layer."""
-    v = getattr(params, "stash_attention_outputs", False)
-    if v != "auto":
-        return bool(v)
-    seq = params.sequence_length // max(1, params.token_patch_size)
-    if seq < 2048 or seq % 128:
-        return False
-    from ..utils.flops import device_hbm_bytes
-    import numpy as np
-    calc_bytes = np.dtype(params.calculation_dtype).itemsize
-    per_layer = (params.train_batch_size * seq * params.heads
-                 * params.features_per_head * calc_bytes
-                 + params.train_batch_size * params.heads * seq * 4)
-    total = per_layer * params.depth * max(1, params.macro_batching)
-    device = None
-    if mesh is not None and getattr(mesh, "devices", None) is not None:
-        shards = 1
-        for axis in ("data", "model", "sequence"):
-            shards *= mesh.shape.get(axis, 1)
-        total = -(-total // shards)
-        device = np.asarray(mesh.devices).flat[0]
-    return total <= 0.15 * device_hbm_bytes(device)
+    """Back-compat boolean view of the remat policy: ``True`` iff the
+    resolved policy is ``"stash"`` — the attention-output stash decision
+    (the (out, lse) pairs riding the strategy custom_vjp residuals; +23%
+    at 16k ctx, docs/PERFORMANCE.md).  The full policy — including the
+    save-vs-recompute choice — lives in :func:`model.remat.resolve_remat`;
+    an explicit legacy ``stash_attention_outputs`` boolean still maps
+    straight onto stash/recompute there."""
+    from .remat import resolve_remat
+    return resolve_remat(params, mesh) == "stash"
 
 
 def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
@@ -530,38 +547,48 @@ def _try_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
     if pro is None:
         return None
     stacked, shared, fns = pro
-    stash = resolve_stash(params, ctx.mesh)
-    if strategy == "revnet":
-        x1, x2 = rev_scan(fns, params.scan_unroll, stacked, shared, src, src,
-                          stash)
-        return x1 + x2
-    if strategy == "momentum":
+    from .remat import resolve_remat
+    policy = resolve_remat(params, ctx.mesh)
+    if strategy in ("revnet", "momentum"):
+        if policy in ("save", "save_dots"):
+            return _strategy_scan_save(params, fns, stacked, shared, src,
+                                       strategy, policy)
+        stash = policy == "stash"
+        if strategy == "revnet":
+            x1, x2 = rev_scan(fns, params.scan_unroll, stacked, shared, src,
+                              src, stash)
+            return x1 + x2
         x, v = momentum_scan(fns, params.momentumnet_alpha, params.scan_unroll,
                              stacked, shared, src, src, stash)
         return x + v
     return _plain_scan(fns, stacked, shared, src, strategy == "checkpoint",
-                       params.scan_unroll)
+                       params.scan_unroll, _checkpoint_policy(params))
 
 
 def _forward_recurrence(strategy: str, alpha: float, pairs, carry,
-                        it=None):
+                        it=None, call=None):
     """One shared forward-only walk of the block recurrences (decode and the
     decode-scan body both use it): revnet/momentum carry two streams, the
-    rest one.  ``pairs`` yields (fn, subset)."""
+    rest one.  ``pairs`` yields (fn, subset).  ``call`` overrides how a
+    block is invoked (the save_dots remat policy wraps each block in
+    jax.checkpoint — model/remat.py block_caller)."""
+    if call is None:
+        def call(f, subset, x, it=None):
+            return f(subset, x, it=it)
     if strategy == "revnet":
         x1, x2 = carry
         for f, subset in pairs:
-            x1, x2 = x2, x1 + f(subset, x2, it=it)
+            x1, x2 = x2, x1 + call(f, subset, x2, it=it)
         return x1, x2
     if strategy == "momentum":
         x, v = carry
         for f, subset in pairs:
-            v = v * alpha + f(subset, x, it=it) * (1 - alpha)
+            v = v * alpha + call(f, subset, x, it=it) * (1 - alpha)
             x = x + v
         return x, v
     (x,) = carry
     for f, subset in pairs:
-        x = f(subset, x, it=it)
+        x = call(f, subset, x, it=it)
     return (x,)
 
 
@@ -909,7 +936,18 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
         if scanned is not None:
             return scanned, plan
 
-    stash = resolve_stash(params, ctx.mesh)
+    from .remat import block_caller, resolve_remat
+    policy = resolve_remat(params, ctx.mesh)
+    stash = policy == "stash"
+    if strategy in ("revnet", "momentum") and policy in ("save",
+                                                         "save_dots"):
+        # unrolled save modes: the identical primal recurrence under native
+        # AD (no custom_vjp) — zero backward recompute, residuals saved
+        call = block_caller(policy)
+        carry = (src, src)
+        streams = _forward_recurrence(strategy, params.momentumnet_alpha,
+                                      zip(fns, subsets), carry, call=call)
+        return sum(streams[1:], streams[0]), plan
     if strategy == "revnet":
         x1, x2 = rev_sequence(tuple(fns), tuple(subsets), src, src, stash)
         return x1 + x2, plan
@@ -920,7 +958,7 @@ def run_body_blocks(params: ModelParameter, src: NamedTensor,
     if strategy == "checkpoint":
         out = src
         for f, s in zip(fns, subsets):
-            out = jax.checkpoint(f)(s, out)
+            out = jax.checkpoint(f, policy=_checkpoint_policy(params))(s, out)
         return out, plan
     # none
     out = src

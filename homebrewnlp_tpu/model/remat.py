@@ -1,0 +1,183 @@
+"""Measured remat policy for the revnet/momentum backward (PR 11).
+
+Replaces the boolean/``auto`` ``stash_attention_outputs`` tri-state with a
+POLICY layer: what the memory-strategy backward does about
+re-materializing block interiors is now one resolved decision
+(:func:`resolve_remat`) consumed by ``model/blocks.py``:
+
+==============  =============================================================
+policy          behavior
+==============  =============================================================
+``recompute``   the strategy ``custom_vjp`` re-runs each block's forward
+                inside ``jax.vjp`` — O(1) activation memory in depth, one
+                extra forward of compute (the historical default)
+``stash``       recompute, but every flash/ring attention layer's
+                ``(out, lse)`` rides the strategy residuals so the backward
+                replay runs no forward attention kernels (and no ring hops)
+                — the old ``stash_attention_outputs: true``
+``save``        NO ``custom_vjp``: the identical primal recurrence under
+                native scan AD; every linearization residual is saved —
+                zero recompute, O(depth) residual memory
+``save_dots``   ``save`` with each block wrapped in ``jax.checkpoint``
+                (policy ``dots_saveable``): GEMM outputs saved, elementwise
+                recomputed — the middle ground for compute-bound chips
+``auto``        resolved below
+==============  =============================================================
+
+All four execute the SAME primal recurrence — losses are bit-identical
+and gradients agree to reconstruction ulps (tests/remat_policy_test.py).
+
+**What auto does, and why (measured — docs/PERFORMANCE.md 'Round 11').**
+The profile-guided A/B on the flagship step measured ``recompute`` 204
+ms/step vs ``save`` 280 vs ``save_dots`` 249 on the CPU rig: the rig is
+memory-bound, so writing + re-reading the stacked per-depth residuals
+costs MORE than re-running the forward — and the committed cost ledger
+classifies every body scope hbm-bound there, which is exactly the
+classification this resolver keys on.  ``auto`` therefore picks:
+
+1. the explicit ``remat_policy`` value when set;
+2. the legacy ``stash_attention_outputs`` boolean when the user set one
+   (``true`` → ``stash``, ``false`` → ``recompute``);
+3. ``stash`` when the long-context stash rule pays and fits (seq >= 2048,
+   % 128 == 0, per-device stash <= 15% of HBM — the measured +23% at 16k);
+4. else ``recompute``.  The save modes stay measured OPT-INS: the A/B
+   lost on the rig, the committed ledger classifies every body scope
+   hbm-bound (residual round-trips are the expensive direction there),
+   and a nominal roofline constant is not evidence enough to flip a
+   default against a measurement.
+
+:func:`remat_report` returns the analytic numbers behind the decision
+(stash bytes, residual estimate, HBM budget, per-block recompute vs
+residual-traffic seconds on the mesh's device roofline) for docs/ops.
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from ..config import ModelParameter
+
+#: fraction of per-chip HBM the attention stash may claim (the historical
+#: resolve_stash gate)
+STASH_HBM_FRACTION = 0.15
+#: fraction of per-chip HBM the save-mode residual estimate may claim —
+#: residuals coexist with params, optimizer state and the batch
+SAVE_HBM_FRACTION = 0.35
+#: f32 activation-sized intermediates a mixer block's linearization keeps
+#: under native AD (norm stats/xhat, glu branches, relu masks, dot
+#: operands) — calibrated against the measured flagship step
+SAVE_RESIDUALS_PER_BLOCK = 16
+
+POLICIES = ("recompute", "stash", "save", "save_dots")
+
+
+def _mesh_geometry(params: ModelParameter, mesh):
+    """(per-device shard divisor, device) for capacity estimates — the
+    stash/residual arrays shard over every data/model/sequence axis."""
+    shards = 1
+    device = None
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        for axis in ("data", "model", "sequence"):
+            shards *= mesh.shape.get(axis, 1)
+        device = np.asarray(mesh.devices).flat[0]
+    return shards, device
+
+
+def _stash_bytes(params: ModelParameter) -> int:
+    """Global attention-stash estimate: one (out [b,s,h,d], lse [b,h,s])
+    pair per block, sized as if every block held one attention layer."""
+    seq = params.sequence_length // max(1, params.token_patch_size)
+    calc_bytes = np.dtype(params.calculation_dtype).itemsize
+    per_layer = (params.train_batch_size * seq * params.heads
+                 * params.features_per_head * calc_bytes
+                 + params.train_batch_size * params.heads * seq * 4)
+    return per_layer * params.depth * max(1, params.macro_batching)
+
+
+def _save_residual_bytes(params: ModelParameter) -> int:
+    """Global estimate of the native-AD linearization residuals the save
+    policy keeps: f32 activation-sized intermediates per block part,
+    stacked over depth by scan AD."""
+    seq = params.sequence_length // max(1, params.token_patch_size)
+    act = params.train_batch_size * seq * params.heads \
+        * params.features_per_head * 4
+    blocks = params.depth * max(1, len(params.block_config))
+    return act * SAVE_RESIDUALS_PER_BLOCK * blocks \
+        * max(1, params.macro_batching)
+
+
+def remat_report(params: ModelParameter, mesh=None) -> typing.Dict[str, typing.Any]:
+    """The analytic inputs to :func:`resolve_remat`, for docs and ops
+    surfaces: per-device byte estimates, the HBM budget they gate on, and
+    the roofline comparison between one block's recompute and its
+    residual round-trip on the mesh's device."""
+    from ..utils.flops import (device_hbm_bytes, peak_flops,
+                               peak_hbm_bandwidth)
+    shards, device = _mesh_geometry(params, mesh)
+    hbm = device_hbm_bytes(device)
+    seq = params.sequence_length // max(1, params.token_patch_size)
+    tokens = params.train_batch_size * seq
+    d_model = params.heads * params.features_per_head
+    # one depth-unit's forward: ~4 d_model^2 GEMMs (the mixer shape) plus
+    # ~12 activation-sized passes of elementwise/norm traffic
+    calc_bytes = np.dtype(params.calculation_dtype).itemsize
+    flops_block = 2 * tokens * d_model * d_model * 4
+    bytes_block = tokens * d_model * calc_bytes * 12
+    resid_block = tokens * d_model * 4 * SAVE_RESIDUALS_PER_BLOCK
+    peak, bw = peak_flops(device), peak_hbm_bandwidth(device)
+    return {
+        "stash_bytes_per_device": -(-_stash_bytes(params) // shards),
+        "save_residual_bytes_per_device":
+            -(-_save_residual_bytes(params) // shards),
+        "hbm_bytes": hbm,
+        "stash_budget_bytes": int(STASH_HBM_FRACTION * hbm),
+        "save_budget_bytes": int(SAVE_HBM_FRACTION * hbm),
+        "recompute_block_s": flops_block / peak + bytes_block / bw,
+        "save_block_s": 2.0 * resid_block / bw,
+        "seq": seq,
+    }
+
+
+def resolve_remat(params: ModelParameter, mesh=None) -> str:
+    """The resolved remat policy for this (config, mesh) — see the module
+    docstring for the decision order."""
+    v = getattr(params, "remat_policy", "auto")
+    if v != "auto":
+        return v
+    legacy = getattr(params, "stash_attention_outputs", "auto")
+    if legacy is True:
+        return "stash"
+    if legacy is False:
+        return "recompute"
+    rep = remat_report(params, mesh)
+    if rep["seq"] >= 2048 and rep["seq"] % 128 == 0 \
+            and rep["stash_bytes_per_device"] <= rep["stash_budget_bytes"]:
+        return "stash"
+    # the save modes stay MEASURED opt-ins: the round-11 A/B on the
+    # flagship step measured recompute 204 / save 280 / save_dots 249
+    # ms/step (the residual round-trip loses on an hbm-bound rig, which is
+    # what the committed cost ledger classifies every body scope as), and
+    # the nominal roofline constants are not trustworthy enough to flip a
+    # default against a measurement — remat_report carries the analytic
+    # comparison for whoever measures a compute-bound chip with spare HBM
+    return "recompute"
+
+
+def block_caller(policy: str):
+    """How the save-mode recurrences invoke a block: plain for ``save``,
+    ``jax.checkpoint(policy=dots_saveable)`` for ``save_dots`` — GEMM
+    outputs saved, elementwise recomputed."""
+    import jax
+
+    if policy == "save_dots":
+        def call(f, subset, x, it=None):
+            return jax.checkpoint(
+                lambda s_, x_, it_: f(s_, x_, it=it_) if it_ is not None
+                else f(s_, x_),
+                policy=jax.checkpoint_policies.dots_saveable)(subset, x, it)
+        return call
+
+    def call(f, subset, x, it=None):
+        return f(subset, x, it=it) if it is not None else f(subset, x)
+    return call

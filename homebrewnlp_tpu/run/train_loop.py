@@ -117,6 +117,50 @@ def _macro_batches(dataset, macro: int):
             yield {k: np.stack([g[k] for g in group]) for k in group[0]}
 
 
+class _AsyncFeeder:
+    """Double-buffered host->device input transfer (``async_input_transfer``,
+    docs/PERFORMANCE.md 'Round 11').
+
+    The historical loop ordering was fetch -> transfer -> dispatch: the
+    next batch's host->device copy only STARTED after the previous step's
+    dispatch returned, so the step-phase spans showed ``data_wait`` +
+    ``dispatch`` serialized against device compute.  This iterator keeps
+    ONE batch in flight: each ``__next__`` returns the batch whose
+    transfer was already started on the PREVIOUS call, then immediately
+    starts the next one via ``Trainer.place_batch`` (``jax.device_put`` /
+    sharded placement — asynchronous on real accelerators), so the copy
+    overlaps the device step dispatched right after.  One extra device
+    batch stays resident; batches are never donated, so there is no
+    aliasing hazard."""
+
+    def __init__(self, it, place):
+        self._it = iter(it)
+        self._place = place
+        self._pending = None
+        self._raised: typing.Optional[BaseException] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._pending is None:
+            if self._raised is not None:
+                raise self._raised
+            self._pending = self._place(next(self._it))
+        out = self._pending
+        self._pending = None
+        try:
+            self._pending = self._place(next(self._it))
+        except BaseException as exc:  # noqa: BLE001 — deferred, not hidden
+            # the CURRENT batch is still valid: hand it out and re-raise
+            # on the NEXT call — StopIteration (normal exhaustion) and
+            # real pipeline errors alike must not cost the step whose
+            # transfer already completed (the historical ordering would
+            # have run that step before ever seeing the failure)
+            self._raised = exc
+        return out
+
+
 def data_slice_geometry(mesh=None):
     """The (slice_index, slice_count) the dataset actually feeds with: the
     data-axis process groups (full model parallelism replicates identical
@@ -486,6 +530,11 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     try:
         batch = first_batch
         data_it = iter(data)
+        if params.async_input_transfer:
+            # overlap the next batch's device transfer with the running
+            # step (docs/PERFORMANCE.md 'Round 11'); the first batch was
+            # already consumed above, so the feeder wraps the remainder
+            data_it = _AsyncFeeder(data_it, trainer.place_batch)
 
         def next_batch():
             """One data fetch, with the data-wait phase recorded when

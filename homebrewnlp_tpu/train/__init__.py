@@ -111,6 +111,10 @@ class Trainer:
             return "non-text (video) model"
         if p.contrastive_across_samples or p.contrastive_across_token_embeddings:
             return "contrastive loss"
+        if p.train_quantized_matmuls:
+            # the fused schedule builds its own per-stage vjps outside
+            # _grads' quantization seam; GPipe routes through loss_of below
+            return "train_quantized_matmuls"
         return None
 
     def _grads(self, variables: Params, batch, rng):
@@ -134,6 +138,16 @@ class Trainer:
                 "(parallel/pipeline.py)", stacklevel=2)
 
         def loss_of(v, idx=None):
+            if p.train_quantized_matmuls:
+                # fake-quantize the live masters INSIDE the differentiated
+                # function: the forward reads the int8 grid, the STE routes
+                # every cotangent to the full-precision master
+                # (core/quant.py; quality guard tests/train_quant_test.py)
+                from ..core import quant as quant_mod
+                v = quant_mod.quantize_for_training(
+                    v, self.model.param_dims,
+                    getattr(self.model, "param_fan_in", {}),
+                    p.calculation_dtype)
             info = self.model.apply(v, batch, rng, mesh=self.mesh)
             return (info.total_loss.data if idx is None
                     else info.loss_list[idx].data), info
@@ -149,6 +163,7 @@ class Trainer:
         # identical
         from ..core import scope as scope_mod
         grad_ctx = scope_mod.Context("apply", mesh=self.mesh)
+        grad_ctx.matmul_accumulation = p.matmul_accumulation
 
         if p.multi_loss_strategy in ("pcgrad", "mgda"):
             # per-loss backward passes, combined by gradient surgery
@@ -268,6 +283,30 @@ class Trainer:
             batch = shardlib.shard_batch(self.params, batch, self.mesh)
         return self._step_fn.lower(state, batch, jax.random.PRNGKey(0))
 
+    def place_batch(self, batch: typing.Dict[str, jax.Array]
+                    ) -> typing.Dict[str, jax.Array]:
+        """Start the host->device transfer of one batch NOW (async on real
+        accelerators): sharded placement over the mesh, or a plain
+        ``device_put`` single-device.  ``step`` recognises the placed
+        arrays and skips re-sharding — the seam the train loop's
+        double-buffered input overlap uses (run/train_loop.py
+        ``_AsyncFeeder``; ``async_input_transfer``)."""
+        if self.mesh is not None:
+            return shardlib.shard_batch(self.params, batch, self.mesh)
+        return {k: (jax.device_put(v) if v is not None else v)
+                for k, v in batch.items()}
+
+    def _batch_placed(self, batch: typing.Dict[str, jax.Array]) -> bool:
+        """True when every leaf already carries this trainer's mesh
+        sharding (``place_batch`` output) — re-running shard_batch on a
+        globally-assembled array would hand
+        ``make_array_from_process_local_data`` a global slice and corrupt
+        the batch on every multi-host layout."""
+        return all(
+            v is None or (isinstance(v, jax.Array)
+                          and getattr(v.sharding, "mesh", None) == self.mesh)
+            for v in batch.values())
+
     def step(self, state: TrainState, batch: typing.Dict[str, jax.Array],
              rng: typing.Optional[jax.Array] = None):
         if self._step_fn is None:
@@ -280,7 +319,7 @@ class Trainer:
             self._rng_counter += 1
             rng = jax.random.PRNGKey(self.params.current_step
                                      + self._rng_counter)
-        if self.mesh is not None:
+        if self.mesh is not None and not self._batch_placed(batch):
             batch = shardlib.shard_batch(self.params, batch, self.mesh)
         return self._step_fn(state, batch, rng)
 
@@ -355,6 +394,6 @@ class Trainer:
                     out[key] = stats
                 return out
             self._stats_fn = jax.jit(stats_fn)
-        if self.mesh is not None:
+        if self.mesh is not None and not self._batch_placed(batch):
             batch = shardlib.shard_batch(p, batch, self.mesh)
         return jax.device_get(self._stats_fn(state.variables, batch, rng))

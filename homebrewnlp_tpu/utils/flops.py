@@ -237,7 +237,12 @@ def _count_split(jaxpr) -> typing.Tuple[int, int]:
             if body_jaxpr is not None:
                 body = _pallas_body_flops(body_jaxpr)
                 total += cells * body
-                name = str(eqn.params.get("name", "") or "")
+                # jax 0.4.37 moved the kernel name param to
+                # ``name_and_src_info`` (str() = "<name> for kernel ...");
+                # without the fallback the causal-dead subtraction silently
+                # never fired and ``executed`` == ``full`` everywhere
+                name = str(eqn.params.get("name", "")
+                           or eqn.params.get("name_and_src_info", "") or "")
                 if "causal" in name and len(grid) == 3 \
                         and all(isinstance(g, int) for g in grid):
                     a, b = grid[1], grid[2]

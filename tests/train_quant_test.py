@@ -1,0 +1,148 @@
+"""Training quantization quality guard (``train_quantized_matmuls``).
+
+The repo's established quantization methodology, applied to the TRAINING
+path (core/quant.py; docs/PERFORMANCE.md 'Round 11'):
+
+* disabled default — the step is BIT-identical to a build that never heard
+  of the knob (the PR 7 parity-test idiom: the plumbing costs nothing when
+  off);
+* enabled — the quantized forward's teacher-forcing argmax agrees with the
+  full-precision forward on >= 99% of positions and the loss stays within
+  noise, gradients flow to the full-precision masters (STE), and training
+  still converges;
+* the compiled quantized train step emits NO float promotion of an int8
+  operand outside the fused dequant scope (graft-lint
+  ``int8_promotion_audit``; a synthetic negative control proves the pass
+  bites).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from backend import make_params
+from homebrewnlp_tpu.analysis import hlo_lint
+from homebrewnlp_tpu.core import quant
+from homebrewnlp_tpu.model import Model
+from homebrewnlp_tpu.train import Trainer
+
+#: eligible-scale config: features_per_head 128 x heads 2 puts the
+#: bottleneck/attention matmul weights over MIN_QUANT_SIZE (same scale as
+#: tests/quant_test.py's serving harness)
+_CFG = dict(features_per_head=128, heads=2, depth=2, train_batch_size=2,
+            sequence_length=16, vocab_size=64,
+            memory_reduction_strategy="revnet",
+            optimizer="sm3-learning_rate", learning_rate=0.01)
+
+
+def _build(**kw):
+    cfg = dict(_CFG)
+    cfg.update(kw)
+    params = make_params(**cfg)
+    model = Model(params)
+    trainer = Trainer(params, model)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    batch = {"token_x": jnp.asarray(x),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+    return params, model, trainer, trainer.init_state(batch), batch
+
+
+def disabled_default_bit_identical_test():
+    """A config that never mentions the knob and one that sets it False
+    produce bit-identical losses AND updated parameters over two steps —
+    the quantization seam costs exactly nothing at the default."""
+    results = []
+    for kw in ({}, {"train_quantized_matmuls": False}):
+        _, _, trainer, state, batch = _build(**kw)
+        losses = []
+        for i in range(2):
+            state, metrics = trainer.step(state, batch,
+                                          jax.random.PRNGKey(i))
+            losses.append(np.asarray(metrics["loss"]))
+        results.append((losses, state))
+    (l0, s0), (l1, s1) = results
+    for a, b in zip(l0, l1):
+        np.testing.assert_array_equal(a, b)
+    for name in s0.variables:
+        np.testing.assert_array_equal(np.asarray(s0.variables[name]),
+                                      np.asarray(s1.variables[name]),
+                                      err_msg=name)
+
+
+def enabled_argmax_agreement_test():
+    """>= 99% teacher-forcing argmax agreement between the quantized and
+    full-precision forward on the SAME weights, loss within noise — the
+    grid the training step reads is the serving-measured one (99.3% on a
+    trained checkpoint)."""
+    params, model, _, state, batch = _build()
+    full = model.apply(state.variables, batch)
+    qvars = quant.quantize_for_training(state.variables, model.param_dims,
+                                        model.param_fan_in,
+                                        params.calculation_dtype)
+    assert any(not np.shares_memory(np.asarray(qvars[k]),
+                                    np.asarray(state.variables[k]))
+               for k in qvars), "quantization was a no-op"
+    quantized = model.apply(qvars, batch)
+    a = np.argmax(np.asarray(full.token_out.data, np.float32), axis=-1)
+    b = np.argmax(np.asarray(quantized.token_out.data, np.float32), axis=-1)
+    agreement = float(np.mean(a == b))
+    assert agreement >= 0.99, f"argmax agreement {agreement:.4f} < 0.99"
+    lf = float(full.total_loss.data)
+    lq = float(quantized.total_loss.data)
+    assert abs(lf - lq) <= max(0.02, 0.01 * abs(lf)), (lf, lq)
+
+
+def enabled_trains_and_updates_masters_test():
+    """With the knob on, gradients reach the full-precision masters via
+    the STE (eligible weights actually move) and the loss still trends
+    down on the synthetic task — fake-quantization must not freeze or
+    poison training."""
+    params, model, trainer, state, batch = _build(
+        train_quantized_matmuls=True)
+    eligible = [k for k, v in state.variables.items()
+                if quant.eligible(k, v, model.param_dims.get(k, ()))]
+    assert eligible, "harness scale produced no eligible weights"
+    before = {k: np.asarray(state.variables[k], np.float32)
+              for k in eligible}
+    first = None
+    rng = np.random.default_rng(1)
+    for i in range(20):
+        x = rng.integers(0, params.vocab_size,
+                         (params.train_batch_size,
+                          params.sequence_length, 1))
+        b = {"token_x": jnp.asarray(x),
+             "token_y": jnp.asarray((x + 1) % params.vocab_size)}
+        state, metrics = trainer.step(state, b, jax.random.PRNGKey(i))
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert np.isfinite(last)
+    assert last < first, (first, last)
+    for k in eligible:
+        assert not np.array_equal(before[k],
+                                  np.asarray(state.variables[k], np.float32)), \
+            f"STE left master {k} frozen"
+
+
+def quantized_step_hlo_int8_promotion_test():
+    """The compiled quantized train step carries int8->float converts ONLY
+    inside the named dequant scope (the property graft-lint's
+    int8_promotion_audit enforces), and the step does carry int8 at all —
+    a vacuously-clean module would prove nothing."""
+    _, _, trainer, state, batch = _build(train_quantized_matmuls=True)
+    hlo = trainer.lowered(state, batch).compile().as_text()
+    assert "s8[" in hlo, "quantized step compiled without any int8 buffer"
+    findings = hlo_lint.int8_promotion_audit("train_step", hlo)
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def int8_promotion_audit_negative_control_test():
+    """A synthetic dequant-scope-less int8 promotion IS flagged (the pass
+    has teeth), while the same line under a dequant scope is not."""
+    bad = ('  %evil = f32[4,256,128]{2,1,0} convert(s8[4,256,128]{2,1,0} '
+           '%w), metadata={op_name="jit(step_fn)/gpt0/body0/somewhere/'
+           'convert_element_type"}')
+    good = bad.replace("body0/somewhere", "body0/dequant")
+    assert hlo_lint.int8_promotion_audit("t", bad)
+    assert not hlo_lint.int8_promotion_audit("t", good)
