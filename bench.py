@@ -83,7 +83,7 @@ def _ensure_live_backend():
     os.environ["_BENCH_BACKEND_CHECKED"] = "1"
 
 
-def compile_probe(steps: int = 2) -> dict:
+def compile_probe(steps: int = 2, cache_dir: str = None) -> dict:
     """Cold-vs-warm setup+compile with the persistent compilation cache
     (``compile_cache_dir``, ROADMAP item 4's measurement half).
 
@@ -92,11 +92,27 @@ def compile_probe(steps: int = 2) -> dict:
     second should hit the persistent cache (warm).  In-process re-builds
     would hit jax's in-memory cache and prove nothing about restarts —
     the tax this knob exists to kill is the ~100s compile on every
-    run_manager relaunch / preemption resume / bench round."""
+    run_manager relaunch / preemption resume / bench round.
+
+    ``cache_dir`` (``--compile-cache-dir``): probe a PERSISTENT directory —
+    the deployment's actual ``compile_cache_dir`` — and RECORD the warm
+    verdict there (``utils/compile_cache.py record_reload_verdict``).  A
+    reload-broken classification (the jax-0.4.37 CPU deserialization heap
+    corruption) then makes ``install_compile_cache`` refuse the cache for
+    this backend + jax version with a loud warning instead of letting the
+    warm relaunch segfault; a healthy probe (e.g. after a jax upgrade)
+    clears the refusal."""
+    import contextlib
     import subprocess
     import tempfile
     out = {}
-    with tempfile.TemporaryDirectory(prefix="bench_compile_cache_") as cache:
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        cache_ctx = contextlib.nullcontext(cache_dir)
+    else:
+        cache_ctx = tempfile.TemporaryDirectory(
+            prefix="bench_compile_cache_")
+    with cache_ctx as cache:
         prog = (
             "import json, sys, time, os\n"
             "t0 = time.monotonic()\n"
@@ -132,7 +148,10 @@ def compile_probe(steps: int = 2) -> dict:
             "                  'compile_warmup_s': round(t2 - t1, 2),\n"
             "                  'total_s': round(t2 - t0, 2)}))\n")
         for phase in ("cold", "warm"):
-            env = dict(os.environ, _BENCH_BACKEND_CHECKED="1")
+            # bypass any recorded refusal inside the probe itself: the
+            # re-probe of an armed dir must exercise the cache for real
+            env = dict(os.environ, _BENCH_BACKEND_CHECKED="1",
+                       HBNLP_COMPILE_CACHE_IGNORE_VERDICT="1")
             res = subprocess.run(
                 [sys.executable, "-c", prog],
                 cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -161,6 +180,25 @@ def compile_probe(steps: int = 2) -> dict:
         out["compile_speedup"] = round(
             out["cold"]["compile_warmup_s"]
             / max(out["warm"]["compile_warmup_s"], 1e-9), 2)
+    if cache_dir:
+        # arm (or clear) install_compile_cache's refusal for this
+        # backend+jax version.  A warm crash after a healthy cold run is
+        # the reload-broken signature; BOTH runs healthy clears it.  A
+        # crashed COLD run is no evidence about reloads at all (the dir
+        # may already hold entries a pre-populated deserialization choked
+        # on, or the build is just broken) — leave any existing verdict
+        # untouched rather than disarming the guard on it
+        from homebrewnlp_tpu.utils.compile_cache import record_reload_verdict
+        if out["cold"].get("crashed"):
+            out["reload_verdict"] = None
+            out["reload_broken"] = None  # no evidence — verdict unchanged
+        else:
+            broken = bool(out["warm"].get("crashed"))
+            evidence = (out["warm"].get("classified", "")
+                        if broken else "warm reload healthy")
+            out["reload_verdict"] = record_reload_verdict(
+                cache_dir, broken, evidence=evidence)
+            out["reload_broken"] = broken
     return out
 
 
@@ -202,10 +240,18 @@ def main(argv=None) -> int:
                     help="measure cold-vs-warm setup+compile with the "
                          "persistent compilation cache in two fresh "
                          "subprocesses, print the JSON, and exit")
+    ap.add_argument("--compile-cache-dir", default=None,
+                    dest="compile_cache_dir",
+                    help="with --compile-probe: probe THIS persistent dir "
+                         "(the deployment's compile_cache_dir) and record "
+                         "the reload verdict there — a reload-broken env "
+                         "then refuses the cache at install time instead "
+                         "of segfaulting the warm relaunch")
     args = ap.parse_args(argv)
     _ensure_live_backend()
     if args.compile_probe:
-        print(json.dumps({"compile_probe": compile_probe()}), flush=True)
+        print(json.dumps({"compile_probe": compile_probe(
+            cache_dir=args.compile_cache_dir)}), flush=True)
         return 0
     import numpy as np
     t_setup = time.monotonic()

@@ -74,6 +74,10 @@ DONATED_JIT_REGISTRY: typing.Dict[str, str] = {
     # init/admit/plain — share one jit site; the steady-state program is
     # audited as "engine_chunk_step")
     "homebrewnlp_tpu/infer/engine.py::_engine_jit": "engine_chunk_step",
+    # the speculative draft+verify chunk step (spec_init/spec_admit/
+    # spec_plain share one jit site; BOTH cache pools ride the donated
+    # carry and are audited as "spec_chunk_step")
+    "homebrewnlp_tpu/infer/engine.py::_spec_jit": "spec_chunk_step",
 }
 
 

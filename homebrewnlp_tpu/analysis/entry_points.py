@@ -54,7 +54,14 @@ AUDIT_CONFIG: typing.Dict[str, typing.Any] = {
 
 #: audited entry points, in budgets.json key order
 ENTRY_POINTS = ("train_step", "decode_chunk_step", "prefill_entry_step",
-                "eval_fn", "engine_chunk_step")
+                "eval_fn", "engine_chunk_step", "spec_chunk_step")
+
+#: the speculative DRAFT at audit scale: the same model definition at a
+#: smaller width (the one-graph-many-layouts rule the production draft
+#: config follows; features_per_head 8 is the narrowest width the audit
+#: architecture's factorized vocab supports)
+DRAFT_AUDIT_OVERRIDES: typing.Dict[str, typing.Any] = {
+    "features_per_head": 8}
 
 
 def build_audit_model(overrides: typing.Optional[dict] = None, seed: int = 0):
@@ -292,6 +299,68 @@ def lower_engine_step(model, variables, token_x, mesh=None):
     return hlo, context
 
 
+def lower_spec_step(model, variables, token_x, draft_model=None,
+                    draft_variables=None, mesh=None):
+    """Compiled donated SPECULATIVE chunk step (``infer/engine.py``
+    ``_spec_jit`` kind ``spec_plain`` — k+1 draft steps + one width-(k+1)
+    verify in a single program): the donated carry holds BOTH cache pools
+    — the target's slot pool AND the quarter-width draft's — and the audit
+    pins every leaf of both aliased input->output with no full-pool-shaped
+    copy.  The verify's sampled-token readback is the only fresh output.
+
+    ``draft_model``/``draft_variables`` default to a fresh
+    ``DRAFT_AUDIT_OVERRIDES`` build; abstract avals throughout, same
+    OOM-safety argument as ``lower_decode_step``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..infer.engine import _spec_jit
+    from ..infer.sampler import decode_cache_shapes
+
+    if draft_model is None:
+        _, draft_model, draft_variables, _, _ = build_audit_model(
+            DRAFT_AUDIT_OVERRIDES, seed=1)
+    aval = jax.ShapeDtypeStruct
+    batch = token_x.shape[0]
+    tps = token_x.shape[2]
+    tshapes = decode_cache_shapes(model, variables, token_x)
+    dshapes = decode_cache_shapes(draft_model, draft_variables, token_x)
+    caches = {k: aval(v.shape, v.dtype) for k, v in tshapes.items()}
+    dcaches = {k: aval(v.shape, v.dtype) for k, v in dshapes.items()}
+    step = _spec_jit(model, draft_model, mesh, "spec_plain",
+                     model.params.spec_draft_tokens)
+    vec_i = aval((batch,), jnp.int32)
+    vec_f = aval((batch,), jnp.float32)
+    vec_b = aval((batch,), jnp.bool_)
+    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
+    seen = aval((batch, model.params.vocab_size), jnp.float32)
+    carry = (aval(tuple(token_x.shape), token_x.dtype), caches, dcaches,
+             key, seen)
+    fargs = (vec_i, vec_f, vec_f)
+    args = (variables, draft_variables, vec_i, vec_i, vec_f, vec_i, fargs,
+            vec_b, aval((batch, tps), jnp.int32), vec_b, vec_i, (), carry)
+    compiled = step.lower(*args).compile()
+    hlo = compiled.as_text()
+    context = {
+        # token_x + key + seen ride the donated carry next to the two pools
+        "donated_leaves": len(tshapes) + len(dshapes) + 3,
+        "protected": (hlo_lint.shape_strings(tshapes, key_filter="/kv")
+                      | hlo_lint.shape_strings(dshapes, key_filter="/kv")),
+        # the two pools share cache key names (same scope paths at two
+        # widths): namespace the draft's for consumers that need a flat map
+        "cache_shapes": {**tshapes,
+                         **{"draft/" + k: v for k, v in dshapes.items()}},
+        "bf16_params": (hlo_lint.shape_strings(variables, min_rank=2,
+                                               dtypes={"bf16"})
+                        | hlo_lint.shape_strings(draft_variables, min_rank=2,
+                                                 dtypes={"bf16"})),
+        "compiled": compiled,
+        "trace": lambda: step.trace(*args).jaxpr,
+    }
+    return hlo, context
+
+
 def _filter_args(batch: int, logits_filter: bool):
     import jax
     import jax.numpy as jnp
@@ -327,6 +396,13 @@ def lower_all(overrides: typing.Optional[dict] = None
                                    trainer=trainer, state=state)
     out["engine_chunk_step"] = lower_engine_step(model, variables,
                                                  jnp.asarray(token_x))
+    draft_overrides = dict(overrides or {})
+    draft_overrides.update(DRAFT_AUDIT_OVERRIDES)
+    _, dmodel, dvariables, _, _ = build_audit_model(draft_overrides, seed=1)
+    out["spec_chunk_step"] = lower_spec_step(model, variables,
+                                             jnp.asarray(token_x),
+                                             draft_model=dmodel,
+                                             draft_variables=dvariables)
     return out
 
 
@@ -352,6 +428,16 @@ def lower_one(entry: str, overrides: typing.Optional[dict] = None
         return lower_decode_step(model, variables, jnp.asarray(token_x))
     if entry == "engine_chunk_step":
         return lower_engine_step(model, variables, jnp.asarray(token_x))
+    if entry == "spec_chunk_step":
+        # the draft shares the caller's overrides (sequence geometry must
+        # match the target — the lower_all merge rule)
+        draft_overrides = dict(overrides or {})
+        draft_overrides.update(DRAFT_AUDIT_OVERRIDES)
+        _, dmodel, dvariables, _, _ = build_audit_model(draft_overrides,
+                                                        seed=1)
+        return lower_spec_step(model, variables, jnp.asarray(token_x),
+                               draft_model=dmodel,
+                               draft_variables=dvariables)
     return lower_prefill_entry(model, variables, jnp.asarray(token_x))
 
 
@@ -379,7 +465,7 @@ def audit_lowered(lowered: "typing.Dict[str, typing.Tuple[str, dict]]",
         budget=train_budget)
 
     for entry in ("decode_chunk_step", "prefill_entry_step",
-                  "engine_chunk_step"):
+                  "engine_chunk_step", "spec_chunk_step"):
         hlo, ctx = lowered[entry]
         findings += hlo_lint.audit(
             entry, hlo,

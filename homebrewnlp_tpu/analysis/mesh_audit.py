@@ -112,7 +112,8 @@ MESH_STRATEGIES: typing.Dict[str, MeshStrategy] = {
     "dp_tp": MeshStrategy(
         "dp_tp",
         {"mesh_shape_override": {"data": 4, "model": 2}},
-        entries=("train_step", "decode_chunk_step", "engine_chunk_step"),
+        entries=("train_step", "decode_chunk_step", "engine_chunk_step",
+                 "spec_chunk_step"),
         sharded_dims={"heads": "model"},
         collective_axes=frozenset({"data", "model"}),
         description="2-D data x tensor parallelism (heads over 'model')"),
@@ -424,6 +425,42 @@ def lower_serving_under_mesh(strategy: MeshStrategy, entry: str,
     elif entry == "engine_chunk_step":
         hlo, ctx = entry_points.lower_engine_step(model, var_avals, tok,
                                                   mesh=mesh)
+    elif entry == "spec_chunk_step":
+        # the draft rides the same strategy at DRAFT_AUDIT_OVERRIDES width;
+        # its param avals carry the same layout-rule shardings as the
+        # target's, so the compiled program shards the draft pool too (the
+        # sharding CONTRACT below stays on the target's leaves — the two
+        # models' param names collide, and the target pool is the one whose
+        # full-replication would be the 8x-HBM regression)
+        dstrategy = dataclasses.replace(
+            strategy, overrides={**dict(strategy.overrides),
+                                 **entry_points.DRAFT_AUDIT_OVERRIDES})
+        dparams, dmodel = _strategy_params_model(dstrategy)
+        dvariables = dmodel.init(batch_np)
+        dvar_avals = {
+            k: jax.ShapeDtypeStruct(
+                np.shape(v), np.asarray(v).dtype,
+                sharding=shardlib.named_sharding(
+                    dparams, dmodel.param_dims.get(k, ()), mesh))
+            for k, v in dvariables.items()}
+        hlo, ctx = entry_points.lower_spec_step(model, var_avals, tok,
+                                                draft_model=dmodel,
+                                                draft_variables=dvar_avals,
+                                                mesh=mesh)
+        # two models in one program share every leaf NAME (same scope paths
+        # at two widths), so the by-name metadata join cannot tell target
+        # from draft parameters: the spec entry keeps the cache-pool
+        # sharded_any contract (a full-shape pool replication is the HBM
+        # regression this pass exists for) and leaves the exact per-param
+        # contract to engine_chunk_step, which audits the identical target
+        # params under the identical layout without the collision
+        protected = _cache_protected(
+            {k: v for k, v in ctx["cache_shapes"].items()
+             if not k.startswith("draft/")})
+        return hlo, {"mesh_shape": dict(mesh.shape), "protected": protected,
+                     "param_bytes": sum(a.size * a.dtype.itemsize
+                                        for a in var_avals.values()),
+                     "compiled": ctx["compiled"]}
     else:
         raise ValueError(f"unsupported serving entry {entry!r}")
     protected = _cache_protected(ctx["cache_shapes"])

@@ -389,6 +389,34 @@ class ModelParameter:
         # scheduling only happens at chunk boundaries.  Steady-state decode
         # uses decode_chunk_tokens
         self.serve_prefill_chunk_tokens = 128
+        # ---- speculative decoding on the slot engine (docs/SERVING.md) ----
+        # draft-and-verify on the continuous engine: each slot runs k cheap
+        # draft steps with a quarter-width draft model, then ONE width-(k+1)
+        # full-model verify step scores every drafted position; the host
+        # accepts the longest matching prefix between donated chunk calls
+        # (greedy output stays bit-identical to the plain engine).  "off" =
+        # never; "draft" = required (serving refuses to start without a
+        # usable draft); "auto" = speculate when a draft is configured and
+        # both models support multi-position decode, plain continuous
+        # serving otherwise
+        self.spec_decode = "off"
+        # the draft model: a config JSON (e.g. the committed quarter-width
+        # configs/1b_long_context_draft_247m.json) or a checkpoint dir
+        # containing config.json; its checkpoints restore from its own
+        # model_path alongside the target's (infer/spec.py)
+        self.spec_draft_model_path = ""
+        # draft tokens per verify (k): each round drafts k tokens and one
+        # verify scores k+1 positions, emitting between 1 (total rejection
+        # — the verify's own token, so forward progress never stalls) and
+        # k+1 (full acceptance + the bonus token) tokens per slot
+        self.spec_draft_tokens = 4
+        # self-disable floor: when the measured sliding-window acceptance
+        # rate drops below this, the engine logs loudly, flips the
+        # hbnlp_spec_state gauge, and PERMANENTLY reverts this process to
+        # the plain continuous engine — a workload the draft cannot predict
+        # must degrade to plain-speed serving, not crawl through rejected
+        # drafts.  0 = never self-disable
+        self.spec_min_accept_rate = 0.2
         # ---- persistent compilation cache (ROADMAP item 5, first sliver) --
         # directory for jax's persistent XLA compilation cache
         # (jax_compilation_cache_dir): warm restarts, run_manager
@@ -496,6 +524,18 @@ class ModelParameter:
         if self.serve_prefill_chunk_tokens < 1:
             raise ValueError("serve_prefill_chunk_tokens must be >= 1, got "
                              f"{self.serve_prefill_chunk_tokens}")
+        # tri-state like serve_engine: a typo would silently serve without
+        # (or refuse to serve with) speculation
+        if self.spec_decode not in ("off", "draft", "auto"):
+            raise ValueError("spec_decode must be \"off\", \"draft\" or "
+                             f"\"auto\", got {self.spec_decode!r}")
+        if self.spec_draft_tokens < 1:
+            raise ValueError("spec_draft_tokens must be >= 1, got "
+                             f"{self.spec_draft_tokens}")
+        if not 0 <= self.spec_min_accept_rate <= 1:
+            raise ValueError("spec_min_accept_rate must be in [0, 1] "
+                             "(0 = never self-disable), got "
+                             f"{self.spec_min_accept_rate}")
         # the serving-default repetition penalty reaches _repetition_penalty
         # whenever a request omits a value (sample mode, REPL, batched
         # rows); r <= 0 would inf/NaN seen tokens' logits — apply the same

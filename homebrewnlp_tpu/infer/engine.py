@@ -44,6 +44,35 @@ Host-side scheduling (FIFO admission, deadlines, breaker interplay) lives in
 ``infer/scheduler.py`` — device-free, so the state machine tests run without
 jax work.  ``infer/rest_api.py`` wires both into the serving device loop
 (config ``serve_engine`` auto/batch/continuous).
+
+**Speculative decoding** (:class:`SpecEngineExecutor`, config
+``spec_decode``; docs/SERVING.md 'Speculative decoding'): decode is
+cache-bytes-bound, so the remaining serving lever is fewer sequential
+full-model steps per emitted token.  Each round is ONE donated chunk call
+(kinds ``spec_init``/``spec_admit``/``spec_plain``) carrying BOTH cache
+pools — target and quarter-width draft — that (1) splices the host's
+accept/reject decision from the previous round (correction token +
+repetition-penalty catch-up), (2) runs k+1 sequential DRAFT steps (the +1
+fills the draft KV row at q+k so a fully-accepted round leaves no cache
+gap), writing k greedy draft tokens into ``token_x`` past each slot's
+position, then (3) runs ONE width-(k+1) full-model VERIFY step
+(``model.apply_decode`` with a k+1-long token slice per slot — the
+multi-position decode path in model/decode.py) that scores every drafted
+position against the full KV pool in a single cache read.  The host then
+takes the longest-accepted-prefix per slot under greedy — emitted tokens
+are accepted drafts plus the verify's own token at the first mismatch (or
+the bonus token after full acceptance), so output is bit-identical to the
+plain engine and progress is >= 1 token/slot/round even at total
+rejection.  Rejected positions need NO explicit KV rollback: decode writes
+every row before attending it and rows only ever re-fill left-to-right, so
+the next round's verify overwrites every rejected row in both pools before
+anything reads it (the same self-heal the admit splice relies on); the
+admit row-zeroing covers slot recycling for both pools.  Models with
+sequence-RECURRENT caches (cumsum, conv windows) cannot self-heal and are
+refused at construction (model/decode.py raises on width > 1).  Per-slot
+acceptance feeds the ``hbnlp_spec_*`` /metrics series, and a sliding-window
+acceptance collapse below ``spec_min_accept_rate`` permanently reverts the
+executor to the plain chunk program (graceful degradation, loudly).
 """
 from __future__ import annotations
 
@@ -55,15 +84,70 @@ from ..config import ModelParameter
 from ..model import Model
 
 
+def _splice_admitted(token_x, seen, ipb, mask, new_rows, pools):
+    """Shared admit splice of the plain AND speculative chunk programs —
+    one definition, because the two must stay bit-identical for the
+    spec-vs-plain parity contract: swap the admitted prompt rows into
+    ``token_x``, reseed the admitted rows' repetition-penalty counts from
+    their prompt region (the ``_kv_prep`` formula — ipb==0 rows count the
+    parity-zeroed index 0), and evict the previous occupant from every
+    cache pool with a per-leaf elementwise select (no full-pool copy — the
+    HLO audits check).  Returns (token_x, seen, pools)."""
+    import jax.numpy as jnp
+
+    from ..model import blocks as blocks_mod
+
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    rows3 = jnp.arange(batch)[:, None, None]
+    token_x = jnp.where(mask[:, None, None], new_rows, token_x)
+    pmask = (jnp.arange(seq)[None, :, None]
+             < jnp.maximum(ipb, 1)[:, None, None]).astype(jnp.float32)
+    seeded = jnp.zeros_like(seen).at[rows3, token_x].add(pmask)
+    seen = jnp.where(mask[:, None], seeded, seen)
+    out_pools = []
+    for pool in pools:
+        pool = dict(pool)
+        for name in list(pool):
+            leaf = pool[name]
+            baxis = 1 if name.startswith(
+                blocks_mod.STACKED_CACHE_PREFIX) else 0
+            bshape = [1] * leaf.ndim
+            bshape[baxis] = batch
+            pool[name] = jnp.where(mask.reshape(bshape),
+                                   jnp.zeros((), leaf.dtype), leaf)
+        out_pools.append(pool)
+    return token_x, seen, out_pools
+
+
+def _sample_logits(logits, seen, tb, fargs, key):
+    """Shared filtered-gumbel token draw of the plain body AND the spec
+    verify (one formula keeps greedy spec-vs-plain parity by
+    construction): repetition penalty over ``seen``, top-k/top-p filters
+    (exact identity on the argmax at disabled defaults), gumbel noise
+    scaled by temperature.  Returns (sampled tokens, next key)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .sampler import _filter_logits, _repetition_penalty
+
+    kb, pb, rb = fargs
+    logits = logits.astype(jnp.float32)          # [b, w, tp, v]
+    logits = _repetition_penalty(logits, seen, rb)
+    logits = _filter_logits(logits, tb, kb, pb)
+    key, sub = jax.random.split(key)
+    u = jax.random.uniform(sub, logits.shape, jnp.float32,
+                           minval=1e-9, maxval=1.0)
+    logits = logits + jnp.log(-jnp.log(u)) * (-tb[:, None, None, None])
+    return jnp.argmax(logits, axis=-1), key
+
+
 def _engine_jit(model: Model, mesh, kind: str):
     """Per-model cache of the jitted engine steps (mirrors
     ``sampler._jit_sampler`` — a fresh closure per dispatch would re-trace
     every chunk)."""
     import jax
 
-    from ..model import blocks as blocks_mod
-    from .sampler import (_filter_logits, _repetition_penalty,
-                          decode_cache_shapes)
+    from .sampler import decode_cache_shapes
 
     cache = model.__dict__.setdefault("_engine_jit_cache", {})
     cache_key = (mesh, kind)
@@ -75,7 +159,6 @@ def _engine_jit(model: Model, mesh, kind: str):
     admit = kind in ("engine_init", "engine_admit")
 
     def step(variables, ipb, tb, end_pos, steps, fargs, admit_args, carry):
-        kb, pb, rb = fargs
         if init_caches:
             q, token_x, key, seen = carry
             # pool built INSIDE the donated trace (like kv_step_init): a
@@ -89,29 +172,12 @@ def _engine_jit(model: Model, mesh, kind: str):
         rows3 = jnp.arange(batch)[:, None, None]
         if admit:
             mask, new_rows = admit_args
-            token_x = jnp.where(mask[:, None, None], new_rows, token_x)
             q = jnp.where(mask, jnp.zeros_like(q), q)
-            # seed the admitted rows' repetition-penalty counts from their
-            # prompt region (the _kv_prep formula — ipb==0 rows count the
-            # parity-zeroed index 0); resident rows keep their counts
-            pmask = (jnp.arange(seq)[None, :, None]
-                     < jnp.maximum(ipb, 1)[:, None, None]).astype(jnp.float32)
-            seeded = jnp.zeros_like(seen).at[rows3, token_x].add(pmask)
-            seen = jnp.where(mask[:, None], seeded, seen)
+            token_x, seen, pools = _splice_admitted(
+                token_x, seen, ipb, mask, new_rows,
+                () if init_caches else (caches,))
             if not init_caches:
-                # evict the previous occupant's state from the admitted
-                # slots: elementwise per-leaf select (no full-pool copy —
-                # the HLO audit checks), batch axis 1 on depth-stacked
-                # leaves, 0 on flat ones
-                for name in list(caches):
-                    leaf = caches[name]
-                    baxis = 1 if name.startswith(
-                        blocks_mod.STACKED_CACHE_PREFIX) else 0
-                    bshape = [1] * leaf.ndim
-                    bshape[baxis] = batch
-                    caches[name] = jnp.where(
-                        mask.reshape(bshape),
-                        jnp.zeros((), leaf.dtype), leaf)
+                caches, = pools
         end_pos = jnp.minimum(end_pos, seq)
 
         def cond_fn(state):
@@ -126,15 +192,8 @@ def _engine_jit(model: Model, mesh, kind: str):
             logits, caches = model.apply_decode(variables, cur, qc, caches,
                                                 mesh=mesh)
             with jax.named_scope("sampling"):
-                logits = logits.astype(jnp.float32)      # [b, 1, tp, v]
-                logits = _repetition_penalty(logits, seen, rb)
-                logits = _filter_logits(logits, tb, kb, pb)
-                key, sub = jax.random.split(key)
-                u = jax.random.uniform(sub, logits.shape, jnp.float32,
-                                       minval=1e-9, maxval=1.0)
-                logits = logits + (jnp.log(-jnp.log(u))
-                                   * (-tb[:, None, None, None]))
-                nxt = jnp.argmax(logits, axis=-1).astype(token_x.dtype)
+                nxt, key = _sample_logits(logits, seen, tb, fargs, key)
+                nxt = nxt.astype(token_x.dtype)
                 qp1 = qc + 1
                 old = jnp.take_along_axis(
                     token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
@@ -158,6 +217,114 @@ def _engine_jit(model: Model, mesh, kind: str):
     # input->output — the invariant graft-lint's engine_chunk_step audit
     # pins on the compiled module (docs/STATIC_ANALYSIS.md)
     cache[cache_key] = jax.jit(step, donate_argnums=(7,))
+    return cache[cache_key]
+
+
+def _spec_jit(model: Model, draft_model: Model, mesh, kind: str, k: int):
+    """Per-model cache of the jitted SPECULATIVE chunk steps (draft + verify
+    in one donated program; see the module docstring for the round shape).
+    ``k`` is the draft depth (``spec_draft_tokens``), passed explicitly —
+    it shapes the program (verify width k+1) and is part of the cache key.
+    Audited as ``spec_chunk_step`` by graft-lint: every leaf of BOTH cache
+    pools aliases input->output, no full-pool copy."""
+    import jax
+
+    from .sampler import decode_cache_shapes
+
+    cache = model.__dict__.setdefault("_spec_jit_cache", {})
+    cache_key = (mesh, kind, id(draft_model), int(k))
+    if cache_key in cache:
+        return cache[cache_key]
+    import jax.numpy as jnp
+
+    init_caches = kind == "spec_init"
+    admit = kind in ("spec_init", "spec_admit")
+    k = int(k)
+
+    def step(variables, dvariables, q, ipb, tb, end_pos, fargs, spec_mask,
+             fix_tok, fix_mask, seen_lo, admit_args, carry):
+        if init_caches:
+            token_x, key, seen = carry
+            caches = {n: jnp.zeros(v.shape, v.dtype) for n, v in
+                      decode_cache_shapes(model, variables, token_x).items()}
+            dcaches = {n: jnp.zeros(v.shape, v.dtype) for n, v in
+                       decode_cache_shapes(draft_model, dvariables,
+                                           token_x).items()}
+        else:
+            token_x, caches, dcaches, key, seen = carry
+        batch, seq = token_x.shape[0], token_x.shape[1]
+        rows3 = jnp.arange(batch)[:, None, None]
+        if admit:
+            # the shared plain-engine splice, over BOTH pools (q is host
+            # state here — the executor zeroed it at admit staging)
+            mask, new_rows = admit_args
+            token_x, seen, pools = _splice_admitted(
+                token_x, seen, ipb, mask, new_rows,
+                () if init_caches else (caches, dcaches))
+            if not init_caches:
+                caches, dcaches = pools
+        end_pos = jnp.minimum(end_pos, seq)
+        qc = jnp.clip(q, 0, seq - 1)
+        # host accept/reject splice: the previous round's correction (or
+        # bonus) token lands at the row's NEW position q — the token this
+        # round's first draft step and verify offset 0 consume
+        old_q = jnp.take_along_axis(token_x, qc[:, None, None], axis=1)
+        fixed = jnp.where(fix_mask[:, None, None], fix_tok[:, None, :],
+                          old_q)
+        token_x = token_x.at[jnp.arange(batch), qc].set(
+            jnp.squeeze(fixed, 1))
+        # repetition-penalty catch-up for the tokens the previous round
+        # emitted: count positions (seen_lo, q] at/past the prompt boundary
+        # (prompt counts were seeded at admit) so `seen` again reflects the
+        # full context below the write position, the plain-body invariant
+        cm = ((jnp.arange(seq)[None, :, None] > seen_lo[:, None, None])
+              & (jnp.arange(seq)[None, :, None] <= q[:, None, None])
+              & (jnp.arange(seq)[None, :, None] >= ipb[:, None, None])
+              ).astype(jnp.float32)
+        seen = seen.at[rows3, token_x].add(cm)
+        active = q < end_pos - 1
+
+        # ---- draft: k+1 sequential quarter-width steps from each slot's
+        # position; k greedy draft tokens written (slots at depth 0 --
+        # spec_mask false -- consume but never write), the +1 step only
+        # fills the draft KV row at q+k so full acceptance leaves no gap
+        def dbody(i, st):
+            token_x, dcaches = st
+            qd = jnp.clip(q + i, 0, seq - 1)
+            cur = jnp.take_along_axis(token_x, qd[:, None, None], axis=1)
+            with jax.named_scope("draft"):
+                dlogits, dc = draft_model.apply_decode(dvariables, cur, qd,
+                                                       dcaches, mesh=mesh)
+            nxt = jnp.argmax(dlogits.astype(jnp.float32), axis=-1
+                             ).astype(token_x.dtype)
+            qp1 = qd + 1
+            old = jnp.take_along_axis(
+                token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
+            wr = active & spec_mask & (i < k) & (qp1 >= ipb)
+            new = jnp.where(wr[:, None, None], nxt, old)
+            token_x = token_x.at[jnp.arange(batch), qp1].set(
+                jnp.squeeze(new, 1), mode="drop")
+            return token_x, dc
+
+        token_x, dcaches = jax.lax.fori_loop(0, k + 1, dbody,
+                                             (token_x, dcaches))
+
+        # ---- verify: ONE width-(k+1) full-model step scores positions
+        # q..q+k per slot against the whole KV pool in a single cache read
+        vidx = jnp.clip(q[:, None] + jnp.arange(k + 1), 0, seq - 1)
+        vtok = jnp.take_along_axis(token_x, vidx[:, :, None], axis=1)
+        with jax.named_scope("verify"):
+            logits, caches = model.apply_decode(variables, vtok, qc, caches,
+                                                mesh=mesh)
+        with jax.named_scope("sampling"):
+            vt, key = _sample_logits(logits, seen, tb, fargs, key)
+            vt = vt.astype(token_x.dtype)
+        return token_x, caches, dcaches, key, seen, vt
+
+    # the carry (argument 12) is DONATED: every leaf of BOTH pools must
+    # alias input->output (graft-lint's spec_chunk_step audit); vt is the
+    # only fresh output — a [slots, k+1, patch] token readback
+    cache[cache_key] = jax.jit(step, donate_argnums=(12,))
     return cache[cache_key]
 
 
@@ -316,3 +483,287 @@ class EngineExecutor:
         self.end_pos[:] = 0
         self.ipb[:] = self.seq - 1
         self.q[:] = 0
+
+
+class SpecEngineExecutor(EngineExecutor):
+    """Draft-and-verify executor: the slot engine with a second
+    (quarter-width) cache pool and the host accept loop.
+
+    ``draft`` is an ``infer.spec`` triple ``(params, model, variables)``.
+    Construction raises for deployments speculation cannot serve — a draft
+    whose vocabulary/sequence geometry differs from the target, or EITHER
+    model carrying sequence-recurrent decode caches (cumsum/conv state the
+    rollback-by-overwrite argument cannot heal; probed here with an
+    abstract width-2 verify trace so ``spec_decode="auto"`` falls back to
+    the plain engine at construction instead of 500ing every dispatch).
+
+    Greedy parity contract: emitted tokens are accepted drafts (which, by
+    the accept rule, EQUAL the verify's argmax) and the verify's own argmax
+    at the first mismatch — so the output stream is exactly the target
+    model's greedy walk, bit-identical to the plain engine
+    (tests/spec_decode_test.py pins it token-for-token, including through
+    a total-rejection draft).
+    """
+
+    #: sliding acceptance window: self-disable consults the last N verify
+    #: rounds once they cover at least MIN_DRAFTED drafted tokens
+    WINDOW_ROUNDS = 64
+    MIN_DRAFTED = 16
+
+    def __init__(self, interface, slots: int, draft,
+                 seed: typing.Optional[int] = None,
+                 draft_tokens: typing.Optional[int] = None,
+                 min_accept_rate: typing.Optional[float] = None):
+        import collections
+
+        import jax
+
+        from . import spec as spec_mod
+        from .sampler import decode_cache_shapes
+
+        super().__init__(interface, slots, seed=seed)
+        p: ModelParameter = interface.params
+        # knobs ride explicit arguments so the caller's RESOLVED params win
+        # (rest_api._resolve_engine serves a params object that may differ
+        # from interface.params — the slots pattern); interface.params is
+        # only the fallback for direct construction
+        self.k = int(getattr(p, "spec_draft_tokens", 4)
+                     if draft_tokens is None else draft_tokens)
+        self.spec_min_accept = float(
+            getattr(p, "spec_min_accept_rate", 0.0)
+            if min_accept_rate is None else min_accept_rate)
+        if self.k + 1 >= self.seq:
+            raise NotImplementedError(
+                f"spec_draft_tokens={self.k} needs a verify width under the "
+                f"sequence length {self.seq}")
+        spec_mod.check_draft_compatible(p, draft[0])
+        self.draft_params_w, self.draft_model_w, self.draft_variables = \
+            spec_mod.draft_for_width(draft, self.slots)
+        # abstract width-2 verify probe of BOTH models: multi-position
+        # support and the no-recurrent-caches rollback contract must fail
+        # CONSTRUCTION (auto -> plain engine), not the first dispatch
+        aval = jax.ShapeDtypeStruct
+        jnp = self._jnp
+        probe = np.zeros((self.slots, self.seq, self.tps), np.int32)
+        for m, v in ((self.model_w, self.variables),
+                     (self.draft_model_w, self.draft_variables)):
+            shapes = decode_cache_shapes(m, v, probe)
+            jax.eval_shape(
+                lambda vv, t, c, mm=m: mm.apply_decode(
+                    vv, t, jnp.zeros(self.slots, jnp.int32), c,
+                    mesh=self.mesh),
+                v, aval((self.slots, 2, self.tps), jnp.int32),
+                {n: aval(s.shape, s.dtype) for n, s in shapes.items()})
+        #: per-slot draft depth (k or 0 — scheduler.spec_depth); all False
+        #: once the acceptance self-disable fires
+        self._spec_mask = np.zeros(self.slots, bool)
+        self._fix_tok = np.zeros((self.slots, self.tps), np.int32)
+        self._fix_mask = np.zeros(self.slots, bool)
+        self._seen_lo = np.zeros(self.slots, np.int32)
+        self._spec_enabled = True
+        self._events: typing.List[dict] = []
+        self._window = collections.deque(maxlen=self.WINDOW_ROUNDS)
+        self.drafted_total = 0
+        self.accepted_total = 0
+        # device mirrors of the slot-staging arguments: they change only at
+        # admit/release, and re-uploading all of them every round is
+        # measurable host overhead next to a multi-token verify round
+        self._dev_args = None
+
+    # -- slot staging --------------------------------------------------------
+
+    def admit(self, slot: int, req) -> None:
+        from .scheduler import spec_depth
+        super().admit(slot, req)
+        self._spec_mask[slot] = (self._spec_enabled and
+                                 spec_depth(req, self._defaults, self.k) > 0)
+        self._fix_mask[slot] = False
+        self._seen_lo[slot] = 0
+        self._dev_args = None
+
+    def release(self, slot: int) -> None:
+        super().release(slot)
+        self._spec_mask[slot] = False
+        self._fix_mask[slot] = False
+        self._dev_args = None
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, steps: int) -> np.ndarray:
+        """Acceptance-aware dispatch: the controller's iteration budget
+        converts to verify ROUNDS (each advances a slot by 1..k+1 tokens);
+        once self-disabled, every dispatch delegates to the plain donated
+        chunk program on the target pool."""
+        if not self._spec_enabled:
+            return super().dispatch(steps)
+        jnp = self._jnp
+        rounds = max(1, -(-int(steps) // (self.k + 1)))
+        for _ in range(rounds):
+            kind = ("spec_init" if self._carry is None else
+                    "spec_admit" if self._admit_mask.any() else "spec_plain")
+            fn = _spec_jit(self.model_w, self.draft_model_w, self.mesh, kind,
+                           self.k)
+            if self._dev_args is None:
+                # slot-staging arguments change only at admit/release: keep
+                # their device copies across rounds (the per-round uploads
+                # are just q / the fix splice / seen_lo)
+                self._dev_args = (jnp.asarray(self.ipb),
+                                  jnp.asarray(self.tb),
+                                  jnp.asarray(self.end_pos),
+                                  (jnp.asarray(self.top_k),
+                                   jnp.asarray(self.top_p),
+                                   jnp.asarray(self.rep)),
+                                  jnp.asarray(self._spec_mask))
+            ipb_d, tb_d, end_d, fargs, mask_d = self._dev_args
+            if kind == "spec_init":
+                seen = jnp.zeros((self.slots, self.params_w.vocab_size),
+                                 jnp.float32)
+                carry = (jnp.asarray(self._token_host), self._key0, seen)
+            else:
+                carry = self._carry
+            admit_args = ()
+            if kind != "spec_plain":
+                admit_args = (jnp.asarray(self._admit_mask),
+                              jnp.asarray(self._admit_rows))
+            out = fn(self.variables, self.draft_variables,
+                     jnp.asarray(self.q.astype(np.int32)),
+                     ipb_d, tb_d, end_d, fargs, mask_d,
+                     jnp.asarray(self._fix_tok),
+                     jnp.asarray(self._fix_mask),
+                     jnp.asarray(self._seen_lo), admit_args, carry)
+            self._carry = out[:5]
+            # per-round D2H: tokens + the verify's sampled tokens (the
+            # accept decision is host-side carry state between chunks).
+            # np.array, not asarray: the accept loop WRITES corrections
+            # into this mirror, and asarray of a device buffer is read-only
+            self._token_host = np.array(out[0])
+            self._admit_mask[:] = False
+            self._accept_round(np.asarray(out[5]))
+            if not self._spec_enabled:
+                break  # self-disabled mid-dispatch: plain takes over
+            if not np.any((self.end_pos > 0)
+                          & (self.q < self.end_pos - 1)):
+                break  # every live slot reached its end
+        return self.q
+
+    # -- host accept loop ----------------------------------------------------
+
+    def _accept_round(self, t: np.ndarray) -> None:
+        """Longest-accepted-prefix per slot: walk the verify's k+1 sampled
+        tokens against the drafted ``token_x`` rows, auto-advancing through
+        prompt positions (chunked prefill at k+1 tokens/round rides the
+        same verify), and stage the correction/bonus token as the next
+        round's fix splice."""
+        k, seq = self.k, self.seq
+        self._fix_mask[:] = False
+        for s in range(self.slots):
+            q0, end = int(self.q[s]), int(min(self.end_pos[s], seq))
+            self._seen_lo[s] = q0
+            if end <= 0 or q0 >= end - 1:
+                continue  # parked / finished: inert
+            ipb = int(self.ipb[s])
+            spec_ok = bool(self._spec_mask[s])
+            adv = 0
+            drafted = accepted = 0
+            for j in range(k + 1):
+                p = q0 + 1 + j
+                if p > end - 1:
+                    break  # the slot's decode extent caps acceptance
+                if p < ipb:
+                    adv += 1  # prompt walk: the verify consumed the real
+                    continue  # prompt token, nothing to compare or write
+                tok = t[s, j]
+                if j < k and spec_ok:
+                    drafted += 1
+                    if np.array_equal(self._token_host[s, p], tok):
+                        accepted += 1
+                        adv += 1
+                        continue
+                # first mismatch (the verify's own token corrects it), the
+                # bonus token after k accepted drafts, or a depth-0 slot's
+                # one sampled token — emit and stop: positions beyond a
+                # correction hold rejected drafts
+                self._fix_tok[s] = tok
+                self._fix_mask[s] = True
+                self._token_host[s, p] = tok
+                adv += 1
+                break
+            self.q[s] = q0 + adv
+            if drafted:
+                self.drafted_total += drafted
+                self.accepted_total += accepted
+                self._window.append((accepted, drafted))
+                self._events.append({"kind": "verify", "slot": s,
+                                     "accepted": accepted,
+                                     "drafted": drafted, "emitted": adv})
+        self._maybe_self_disable()
+
+    def _maybe_self_disable(self) -> None:
+        if not self._spec_enabled or self.spec_min_accept <= 0:
+            return
+        drafted = sum(d for _, d in self._window)
+        if len(self._window) < 8 or drafted < self.MIN_DRAFTED:
+            return
+        rate = sum(a for a, _ in self._window) / drafted
+        if rate >= self.spec_min_accept:
+            return
+        # a workload the draft cannot predict must degrade to plain-speed
+        # serving, not crawl through rejected drafts: log loudly, emit the
+        # metric event, and permanently revert to the plain chunk program
+        print("WARNING: speculative decoding self-disabled — sliding-window "
+              f"acceptance {rate:.3f} < spec_min_accept_rate "
+              f"{self.spec_min_accept} over {drafted} drafted tokens; "
+              "serving continues on the plain continuous engine",
+              flush=True)
+        self._events.append({"kind": "disabled", "rate": rate,
+                             "drafted": drafted})
+        self._spec_enabled = False
+        self._spec_mask[:] = False
+        self._to_plain_carry()
+
+    def _to_plain_carry(self) -> None:
+        """Convert the spec carry into the plain engine's donated carry:
+        the host token mirror already holds every emitted token (including
+        corrections the device never saw), so token_x re-uploads from it;
+        ``seen`` gets the same host-side catch-up the next spec round would
+        have applied; the draft pool is dropped (freed)."""
+        if self._carry is None or len(self._carry) != 5:
+            return
+        jnp = self._jnp
+        _, caches, _, key, seen = self._carry
+        seen_np = np.array(seen)  # copy: device buffers read back read-only
+        for s in range(self.slots):
+            lo, hi = int(self._seen_lo[s]), int(self.q[s])
+            ipb = int(self.ipb[s])
+            for p in range(max(lo + 1, ipb, 1), hi + 1):
+                if p < self.seq:
+                    for lane in self._token_host[s, p]:
+                        seen_np[s, int(lane)] += 1.0
+        self._fix_mask[:] = False
+        self._carry = (jnp.asarray(self.q.astype(np.int32)),
+                       jnp.asarray(self._token_host), caches, key,
+                       jnp.asarray(seen_np))
+
+    # -- observability -------------------------------------------------------
+
+    def take_spec_events(self) -> typing.List[dict]:
+        """Drain the per-verify accept events (scheduler forwards them as
+        hooks, rest_api turns them into the hbnlp_spec_* series)."""
+        out, self._events = self._events, []
+        return out
+
+    def spec_summary(self) -> dict:
+        """Ops surface for /health: the acceptance economics at a glance."""
+        drafted = max(1, self.drafted_total)
+        return {"enabled": bool(self._spec_enabled),
+                "draft_tokens": self.k,
+                "drafted": int(self.drafted_total),
+                "accepted": int(self.accepted_total),
+                "accept_rate": round(self.accepted_total / drafted, 4)}
+
+    def reset(self) -> None:
+        super().reset()
+        self._fix_mask[:] = False
+        self._spec_mask[:] = False
+        self._seen_lo[:] = 0
+        self._dev_args = None  # reset parks every slot: end_pos changed

@@ -44,6 +44,26 @@ class Tokenizer:
         return bytes(t % 256 for t in toks).decode("utf-8", "replace")
 
 
+def model_width_view(params: ModelParameter, model: Model, width: int):
+    """A batch-``width`` ``(params, Model)`` view over the SAME variables.
+
+    The block plan and parameter dims are batch-size independent
+    (``BlockSpec = (depth, cfg, names)``), so the view shares them instead
+    of re-running init — which would materialise, and discard, a full
+    host-numpy copy of every parameter per width.  One definition serves
+    the serving interface's width cache AND the speculative draft's width
+    view (infer/spec.py), so batch-independent model attributes cannot
+    silently diverge between the two."""
+    p = ModelParameter(params, train_batch_size=width)
+    p.train = False
+    m = Model(p)
+    m.plan = model.plan
+    m.param_dims = dict(model.param_dims)
+    m.param_fan_in = dict(getattr(model, "param_fan_in", {}))
+    m.quant_scales = getattr(model, "quant_scales", None)
+    return p, m
+
+
 class InterfaceWrapper:
     """complete(prompt, temperature, response_len) over a loaded model.
 
@@ -78,18 +98,8 @@ class InterfaceWrapper:
 
     def _model_for_width(self, width: int):
         if width not in self._width_models:
-            p = ModelParameter(self.params, train_batch_size=width)
-            p.train = False
-            m = Model(p)
-            # the block plan and parameter dims are batch-size independent
-            # (BlockSpec = (depth, cfg, names)); share them instead of
-            # re-running init, which would materialise — and discard — a
-            # full host-numpy copy of every parameter per new width
-            m.plan = self.model.plan
-            m.param_dims = dict(self.model.param_dims)
-            m.param_fan_in = dict(getattr(self.model, "param_fan_in", {}))
-            m.quant_scales = getattr(self.model, "quant_scales", None)
-            self._width_models[width] = (p, m)
+            self._width_models[width] = model_width_view(self.params,
+                                                         self.model, width)
         return self._width_models[width]
 
     def decode_path(self, width: typing.Optional[int] = None) -> dict:

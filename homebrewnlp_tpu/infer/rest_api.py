@@ -152,6 +152,35 @@ def _serve_metrics() -> dict:
             "recycled": r.counter(
                 "hbnlp_serve_engine_recycled_total",
                 "finished slots recycled for the next admission"),
+            # speculative decoding (docs/SERVING.md 'Speculative
+            # decoding'): acceptance rate IS the economics of the feature —
+            # tokens/sec scales with accepted drafts per verify, so the
+            # per-slot acceptance distribution and the accepted-tokens
+            # yield are first-class series
+            "spec_accept_rate": r.histogram(
+                "hbnlp_spec_accept_rate",
+                "per-slot per-verify draft acceptance fraction "
+                "(accepted / drafted, one sample per verify round)",
+                buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                         1.0)),
+            "spec_accepted_per_verify": r.gauge(
+                "hbnlp_spec_accepted_tokens_per_verify",
+                "running mean of accepted draft tokens per verify step "
+                "(the speedup numerator: emitted tokens/verify = this + 1)"),
+            "spec_drafted": r.counter(
+                "hbnlp_spec_drafted_tokens_total",
+                "draft tokens scored by a verify step"),
+            "spec_accepted": r.counter(
+                "hbnlp_spec_accepted_tokens_total",
+                "draft tokens accepted by a verify step"),
+            "spec_state": r.gauge(
+                "hbnlp_spec_state",
+                "speculative decoding state: 1 active, 0 self-disabled "
+                "(acceptance below spec_min_accept_rate) or off"),
+            "spec_disabled": r.counter(
+                "hbnlp_spec_disabled_total",
+                "acceptance-collapse self-disables (the engine reverted to "
+                "the plain continuous program)"),
         }
     return _SERVE_METRICS
 
@@ -936,11 +965,46 @@ def _resolve_engine(params: ModelParameter, interface):
     and falls back to batch-to-completion otherwise (stub interfaces, video
     models, layers without a streaming form)."""
     mode = str(getattr(params, "serve_engine", "auto") or "auto")
+    spec_mode = str(getattr(params, "spec_decode", "off") or "off")
     if mode == "batch":
+        if spec_mode == "draft":
+            # "draft" promises speculation or no serving at all; the batch
+            # engine cannot speculate, so the combination is a config
+            # contradiction — refuse loudly instead of silently serving
+            # batch-to-completion under a knob that says "required"
+            raise RuntimeError(
+                "spec_decode=\"draft\" requires the continuous engine, but "
+                "serve_engine=\"batch\" disables it — set serve_engine to "
+                "\"auto\"/\"continuous\" or spec_decode to \"off\"/\"auto\"")
         return None
+    slots = max(1, int(getattr(params, "serve_slots", 8) or 1))
+    if spec_mode != "off":
+        # speculative decoding rides the continuous engine: build the draft
+        # (bench/test callers attach a ready triple as interface.draft; the
+        # production path loads spec_draft_model_path through the
+        # checkpoint walk) and the spec executor.  "draft" makes any
+        # failure fatal; "auto" falls back to the PLAIN continuous engine
+        # below — never silently to batch-to-completion
+        try:
+            from . import spec as spec_mod
+            from .engine import SpecEngineExecutor
+            draft = getattr(interface, "draft", None)
+            if draft is None:
+                draft = spec_mod.load_draft(params)
+            return SpecEngineExecutor(
+                interface, slots, draft,
+                draft_tokens=int(getattr(params, "spec_draft_tokens", 4)),
+                min_accept_rate=float(getattr(params,
+                                              "spec_min_accept_rate", 0.0)))
+        except Exception as e:
+            if spec_mode == "draft":
+                raise RuntimeError(
+                    "spec_decode=draft but speculative decoding cannot "
+                    f"serve this deployment: {e!r}") from e
+            print(f"speculative decoding unavailable ({e!r}); serving the "
+                  "plain continuous engine")
     try:
         from .engine import EngineExecutor
-        slots = max(1, int(getattr(params, "serve_slots", 8) or 1))
         return EngineExecutor(interface, slots)
     except Exception as e:
         if mode == "continuous":
@@ -987,6 +1051,12 @@ def _engine_hooks_fn(interface, scheduler, executor):
     age, residency, admitted/evicted/recycled, TTFT/ITL, cache bandwidth)."""
     m = _serve_metrics()
     m["slots_total"].set(executor.slots)
+    # speculative engine: state gauge starts at 1 (active) so a scrape can
+    # tell "speculating" from "off" before the first verify lands
+    spec = hasattr(executor, "take_spec_events")
+    if spec:
+        m["spec_state"].set(1)
+    verifies = [0]
 
     def hooks(event, **kw):
         # telemetry must never fail a decode round — but say so (the
@@ -1028,6 +1098,20 @@ def _engine_hooks_fn(interface, scheduler, executor):
         elif event == "recycled":
             m["recycled"].inc()
             m["slot_residency"].observe(float(kw.get("residency") or 0.0))
+        elif event == "spec_verify":
+            drafted = int(kw.get("drafted") or 0)
+            accepted = int(kw.get("accepted") or 0)
+            if drafted:
+                verifies[0] += 1
+                m["spec_accept_rate"].observe(accepted / drafted)
+                m["spec_drafted"].inc(drafted)
+                m["spec_accepted"].inc(accepted)
+                m["spec_accepted_per_verify"].set(
+                    getattr(executor, "accepted_total", accepted)
+                    / verifies[0])
+        elif event == "spec_disabled":
+            m["spec_disabled"].inc()
+            m["spec_state"].set(0)
         m["slots_occupied"].set(len(scheduler.resident))
 
     return hooks
@@ -1137,9 +1221,14 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                                       128) or 128),
             answer=_engine_answer_fn(interface, _respond),
             hooks=_engine_hooks_fn(interface, scheduler, executor))
+    engine_info = {"mode": "continuous" if controller else "batch",
+                   "slots": executor.slots if executor else 0}
+    if hasattr(executor, "spec_summary"):
+        # speculative engine: surface the acceptance economics on /health
+        # (the live rate rides /metrics; this is the startup config view)
+        engine_info["spec"] = executor.spec_summary()
     state.update(model_loaded=True, decode_path=decode_path, inflight=0,
-                 engine={"mode": "continuous" if controller else "batch",
-                         "slots": executor.slots if executor else 0})
+                 engine=engine_info)
     guard.publish(state, interface)
 
     def spawn_child():

@@ -62,6 +62,31 @@ class EngineRequest:
         return min(seq, n + int(self.response_len))
 
 
+def spec_depth(req: EngineRequest, defaults: typing.Tuple[int, float, float],
+               k: int) -> int:
+    """Per-slot draft depth for the speculative engine: ``k`` for requests
+    the accept rule can serve BIT-identically — greedy (temperature 0) with
+    every logits filter at its disabled default — and 0 for everything
+    else.  A depth-0 slot rides the same verify step but advances exactly
+    one sampled token per round (the plain-step semantics), so mixed
+    workloads co-reside in one chunk program instead of forking the engine.
+
+    ``defaults`` are the config fallbacks ``(top_k, top_p, rep_penalty)``
+    that apply when the request leaves a knob unset (the executor's
+    ``_defaults``); the repetition penalty matters because the verify
+    scores all k+1 positions with the ``seen`` counts as of the ROUND
+    START — exact for one token, stale for drafted positions beyond it."""
+    if float(req.temperature) != 0.0:
+        return 0
+    tk, tp, rp = defaults
+    top_k = tk if req.top_k is None else int(req.top_k)
+    top_p = tp if req.top_p is None else float(req.top_p)
+    rep = rp if req.rep_penalty is None else float(req.rep_penalty)
+    if top_k > 0 or top_p < 1.0 or rep != 1.0:
+        return 0
+    return int(k)
+
+
 class SlotScheduler:
     """FIFO pending queue over a fixed slot set."""
 
@@ -261,6 +286,15 @@ class EngineController:
             self.guard.record_decode_success()
         advanced = int(max(0, (q_after - q_before).max()))
         seq = self.executor.seq
+        # acceptance-aware dispatch (speculative engine): the executor
+        # records per-verify accept/draft counts and a one-shot self-disable
+        # — forward them as hook events so the serving layer can export the
+        # acceptance economics (hbnlp_spec_* series) without the scheduler
+        # knowing the engine flavor
+        take = getattr(self.executor, "take_spec_events", None)
+        if take is not None:
+            for ev in take():
+                self.hooks("spec_" + ev.pop("kind"), **ev)
         # tokens generated this chunk: per row, write positions q+1..q' that
         # lie at/past the prompt boundary (prompt-walking steps don't count)
         generated = 0

@@ -1,0 +1,111 @@
+"""Draft-model plumbing for speculative decoding (docs/SERVING.md).
+
+The engine's draft-and-verify loop (infer/engine.py ``SpecEngineExecutor``)
+needs a SECOND model — the quarter-width draft — restored alongside the
+serving target.  Per the one-graph-many-layouts thesis the draft is the
+SAME model definition at a smaller shape (the committed
+``configs/1b_long_context_draft_247m.json`` artifact), not a forked code
+path: this module loads its config, restores its checkpoint through the
+same corruption-tolerant ``restore_latest_valid`` walk the target uses
+(train/checkpoint.py), and builds the batch-width views the slot engine
+decodes through.
+
+A draft triple is ``(params, model, variables)``; callers that already hold
+one (the serving bench distills its own) attach it as ``interface.draft``
+and skip the loader entirely.
+"""
+from __future__ import annotations
+
+import json
+import os
+import typing
+
+from ..config import ModelParameter
+
+#: the draft triple: (ModelParameter, Model, variables)
+DraftTriple = typing.Tuple[typing.Any, typing.Any, typing.Dict[str, typing.Any]]
+
+
+def draft_config_path(path: str) -> str:
+    """Resolve ``spec_draft_model_path`` to a config JSON: the path itself
+    when it names a JSON file, else ``<path>/config.json``."""
+    if path.endswith(".json"):
+        return path
+    return os.path.join(path, "config.json")
+
+
+def load_draft(params: ModelParameter) -> DraftTriple:
+    """Build + restore the draft model named by ``spec_draft_model_path``.
+
+    The draft's variables restore from ITS config's ``model_path`` through
+    ``restore_latest_valid(strict=True)`` — a corrupt draft run refuses to
+    serve random drafts silently, exactly like the target's loader
+    (run/modes.py ``_load_model``).  A draft with NO checkpoints loads at
+    random init with a loud note: acceptance will be ~zero and the engine's
+    ``spec_min_accept_rate`` self-disable is expected to fire — useful for
+    smoke tests, never for production.
+    """
+    import numpy as np
+
+    from ..model import Model
+    from ..train import checkpoint as ckpt
+
+    path = str(getattr(params, "spec_draft_model_path", "") or "")
+    if not path:
+        raise ValueError("spec_decode needs spec_draft_model_path (a config "
+                         "JSON or a checkpoint dir with config.json)")
+    cfg_path = draft_config_path(path)
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    # serving-shape knobs follow the TARGET: the draft rides the same
+    # token_x (same sequence geometry) and the same slot pool width
+    cfg.update(sequence_length=params.sequence_length,
+               token_patch_size=params.token_patch_size,
+               train_batch_size=1)
+    dparams = ModelParameter(cfg)
+    dparams.train = False
+    check_draft_compatible(params, dparams)
+    dmodel = Model(dparams)
+    seq = dparams.sequence_dim.size
+    zeros = np.zeros((1, seq, dparams.token_patch_dim.size), np.int32)
+    variables = dmodel.init({"token_x": zeros, "token_y": zeros})
+    restored = ckpt.restore_latest_valid(dparams.model_path, strict=True)
+    if restored:
+        loaded, _, step, _ = restored
+        variables = {k: np.asarray(loaded[k]).astype(variables[k].dtype)
+                     if k in loaded else v for k, v in variables.items()}
+        print(f"loaded draft checkpoint at step {step} ({dparams.model_path})")
+    else:
+        print(f"WARNING: draft {dparams.model_path} has no checkpoint — "
+              "drafting from RANDOM init (acceptance ~0; expect the "
+              "spec_min_accept_rate self-disable to fire)")
+    import jax.numpy as jnp
+    return dparams, dmodel, {k: jnp.asarray(v) for k, v in variables.items()}
+
+
+def check_draft_compatible(params: ModelParameter,
+                           dparams: ModelParameter) -> None:
+    """The draft decodes the TARGET's token stream in place: vocabulary and
+    sequence geometry must match exactly, and both must be streaming text
+    models.  Raises ValueError naming the mismatch."""
+    for knob in ("vocab_size", "sequence_length", "token_patch_size"):
+        a, b = getattr(params, knob), getattr(dparams, knob)
+        if a != b:
+            raise ValueError(f"draft/target {knob} mismatch: target {a}, "
+                             f"draft {b} — the draft rides the target's "
+                             "token stream and must share its geometry")
+    if dparams.use_video or not dparams.use_language:
+        raise ValueError("the draft must be a text (gpt-mode) model")
+
+
+def draft_for_width(draft: DraftTriple, width: int) -> DraftTriple:
+    """A batch-``width`` view over the SAME draft variables (the shared
+    ``interface.model_width_view`` helper — plan/param-dims sharing lives
+    in exactly one place)."""
+    from .interface import model_width_view
+
+    dparams, dmodel, dvariables = draft
+    if dparams.train_batch_size == width:
+        return draft
+    p, m = model_width_view(dparams, dmodel, width)
+    return p, m, dvariables

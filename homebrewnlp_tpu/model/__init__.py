@@ -425,25 +425,34 @@ class Model:
                      ) -> typing.Tuple[jax.Array, typing.Dict[str, jax.Array]]:
         """One incremental-decode step (model/decode.py).
 
-        ``token_slice``: the input token at ``pos``, shaped like token_x with
-        the sequence axis of length 1.  Returns (next-token logits at ``pos``
-        as [batch, 1, token_patch, vocab], updated caches).  Replaces the
-        reference sampler's full forward per token
-        (/root/reference/src/run/inference.py:76-97) with O(1)-per-step
-        compute; only valid for causal text models (use_video off).
+        ``token_slice``: the input tokens at ``pos``, shaped like token_x
+        with the sequence axis of length ``width`` (1 for every classic
+        sampler; the speculative VERIFY step passes ``k + 1`` consecutive
+        tokens per row and scores all of them in this one call — the width
+        is inferred from the slice shape).  Returns (next-token logits at
+        ``pos .. pos + width - 1`` as [batch, width, token_patch, vocab],
+        updated caches).  Replaces the reference sampler's full forward per
+        token (/root/reference/src/run/inference.py:76-97) with
+        O(width)-per-step compute; only valid for causal text models
+        (use_video off).
         """
         from .decode import DecodeState
         assert self.plan is not None, "call init() first (or assign .plan)"
         p = self.params
         assert not p.use_video and p.use_language, \
             "incremental decode supports text (gpt) mode only"
+        width = int(token_slice.shape[1])
+        assert width < p.sequence_dim.size, \
+            "decode slice must be narrower than the sequence (use apply)"
         state = DecodeState(jnp.asarray(pos, jnp.int32), p.sequence_dim.size,
                             p.sequence_dim.name, caches,
-                            cache_dtype=p.decode_cache_dtype, model_params=p)
+                            cache_dtype=p.decode_cache_dtype, model_params=p,
+                            width=width)
         ctx = scope.Context("apply", params=variables, mesh=mesh, decode=state)
         ctx.quant_scales = getattr(self, "quant_scales", None)
         ctx.matmul_accumulation = p.matmul_accumulation
-        decode_dims = [Dim(d.name, 1) if d.name == p.sequence_dim.name else d
+        decode_dims = [Dim(d.name, width)
+                       if d.name == p.sequence_dim.name else d
                        for d in p.token_dim_shape]
         with scope.context(ctx):
             tok = nt(token_slice, decode_dims)

@@ -732,7 +732,8 @@ def _try_decode_scan(params: ModelParameter, ctx, plan, src: NamedTensor,
         sub = decode_mod.DecodeState(state.pos, state.seq_len, state.seq_name,
                                      sl_caches,
                                      cache_dtype=state.cache_dtype,
-                                     model_params=state.model_params)
+                                     model_params=state.model_params,
+                                     width=state.width)
         saved_decode = ctx.decode
         ctx.decode = sub
         try:

@@ -45,14 +45,30 @@ class DecodeState:
     (:func:`scatter_rows`), causal masks compare keys against each row's own
     position (model/utils.py ``compare_range``), and position embeddings
     gather each row's own row (model/embedding.py).  Every vector branch is
-    gated on ``pos.ndim`` so the scalar paths stay byte-identical."""
+    gated on ``pos.ndim`` so the scalar paths stay byte-identical.
+
+    ``width`` is the query-slice length: 1 in every classic sampler (one
+    token per step), ``k + 1`` under the speculative-decoding VERIFY step
+    (infer/engine.py), where the model scores ``width`` consecutive
+    positions ``pos .. pos + width - 1`` per row in ONE call — the KV
+    scatter lands ``width`` rows (all written before attention reads the
+    buffer, so verify query i attends exactly rows ``0 .. pos + i`` under
+    the causal mask), masks and position embeddings evaluate the per-row
+    range ``pos + arange(width)``, and the sequence-RECURRENCE caches
+    (cumsum totals, conv windows) refuse (NotImplementedError): their
+    running state cannot be rolled back when a drafted position is
+    rejected, so a model carrying them cannot be speculatively verified at
+    all.  Every ``width > 1`` branch is additive — width-1 code paths are
+    untouched."""
 
     def __init__(self, pos: jax.Array, seq_len: int, seq_name: str,
                  caches: typing.Dict[str, jax.Array],
-                 cache_dtype: typing.Any = None, model_params=None):
+                 cache_dtype: typing.Any = None, model_params=None,
+                 width: int = 1):
         self.pos = pos
         self.seq_len = seq_len
         self.seq_name = seq_name
+        self.width = int(width)
         self.caches = caches
         # storage dtype override for the full-length KV buffers (config
         # ``decode_cache_dtype``); None keeps the calculation dtype.  The
@@ -129,9 +145,11 @@ def is_prefill_dim(state: typing.Optional[PrefillState], dim: Dim) -> bool:
 
 
 def is_decode_dim(state: typing.Optional[DecodeState], dim: Dim) -> bool:
-    """True when ``dim`` is the length-1 stand-in for the full sequence."""
+    """True when ``dim`` is the length-``width`` stand-in for the full
+    sequence (width 1 for every classic sampler)."""
     return (state is not None and dim.name == state.seq_name
-            and dim.size == 1 and state.seq_len != 1)
+            and dim.size == getattr(state, "width", 1)
+            and state.seq_len != dim.size)
 
 
 def key_dim_for(state: typing.Optional[DecodeState], dim: Dim) -> Dim:
@@ -178,21 +196,35 @@ def is_vector_pos(pos) -> bool:
 
 def scatter_rows(buf: jax.Array, row: jax.Array, pos: jax.Array,
                  axis: int) -> jax.Array:
-    """Scatter a length-1 slice into ``buf`` at PER-ROW positions.
+    """Scatter a length-``m`` slice into ``buf`` at PER-ROW positions.
 
     ``buf``: ``[batch, ...]`` (batch leading), ``row``: same shape with
-    size 1 at ``axis``, ``pos``: int32 ``[batch]``.  The per-row analogue of
+    size m at ``axis`` (1 for every classic sampler; the verify width for
+    speculative decoding), ``pos``: int32 ``[batch]`` — row b's slice lands
+    at positions ``pos[b] .. pos[b] + m - 1``.  The per-row analogue of
     ``dynamic_update_slice_in_dim`` — lowers to one HLO scatter, which the
     aliaser keeps in place under donation exactly like the slice update
     (the engine's HLO audit pins that).  Out-of-range positions DROP their
-    update (finished slots parked past their end write nothing)."""
+    update (finished slots parked past their end write nothing; verify
+    positions past the sequence end write nothing)."""
+    m = row.shape[axis]
     idx: typing.List[typing.Any] = [slice(None)] * buf.ndim
-    idx[0] = jnp.arange(buf.shape[0])
-    idx[axis] = pos
-    # with batch leading, the gather/scatter value shape is [batch] + the
-    # remaining dims in original order whether or not the two advanced
-    # indices are adjacent — exactly row with its size-1 axis squeezed
-    return buf.at[tuple(idx)].set(jnp.squeeze(row, axis=axis), mode="drop")
+    if m == 1:
+        idx[0] = jnp.arange(buf.shape[0])
+        idx[axis] = pos
+        # with batch leading, the gather/scatter value shape is [batch] +
+        # the remaining dims in original order whether or not the two
+        # advanced indices are adjacent — exactly row with its size-1 axis
+        # squeezed
+        return buf.at[tuple(idx)].set(jnp.squeeze(row, axis=axis),
+                                      mode="drop")
+    idx[0] = jnp.arange(buf.shape[0])[:, None]
+    idx[axis] = pos[:, None] + jnp.arange(m)
+    # the [batch, m] advanced indices put the scatter value's batch and
+    # position axes first (in place when adjacent at axes 0/1, hoisted to
+    # the front otherwise — both land at [batch, m] + rest), so the slice's
+    # position axis moves next to batch
+    return buf.at[tuple(idx)].set(jnp.moveaxis(row, axis, 1), mode="drop")
 
 
 def _row_write(state: "DecodeState", buf: jax.Array, row: jax.Array,
@@ -201,6 +233,16 @@ def _row_write(state: "DecodeState", buf: jax.Array, row: jax.Array,
     samplers, per-row scatter for the engine's position vector."""
     if is_vector_pos(state.pos):
         return scatter_rows(buf, row, state.pos, axis)
+    if row.shape[axis] != 1:
+        # dynamic_update_slice CLAMPS its start index: a width-m slice
+        # near the sequence end would silently shift every row while the
+        # masks use the unclamped range.  The vector path drops
+        # out-of-range rows instead; scalar callers are all width 1 today,
+        # so refuse rather than mis-write
+        raise NotImplementedError(
+            "multi-position decode with a SCALAR position is unsupported "
+            "(clamped slice writes would misalign with the causal masks); "
+            "pass a per-row position vector")
     return jax.lax.dynamic_update_slice_in_dim(buf, row, state.pos, axis)
 
 
@@ -374,6 +416,16 @@ def running_sum(x: NamedTensor) -> NamedTensor:
     """total' = total + x; returns total' (decode-time cumsum over pos)."""
     state = active()
     assert state is not None
+    if state.width != 1:
+        # the running total is sequence-RECURRENT state: a multi-position
+        # verify step cannot roll it back when drafted positions are
+        # rejected (KV rows self-heal through the causal write-before-read
+        # order; a running sum does not).  Speculative decoding probes this
+        # at construction and refuses models that reach here.
+        raise NotImplementedError(
+            "multi-position decode (speculative verify) does not support "
+            "cumsum/cummean decode caches — their running state cannot be "
+            "rolled back on draft rejection")
     ctx = scope.current()
     name = "cache/" + ctx.full_name("cumsum")
     buf = _cache(name, [d.size for d in x.dims], x.data.dtype)
@@ -391,6 +443,13 @@ def rolling_window(x: NamedTensor, dim: Dim, window: int) -> NamedTensor:
     """
     state = active()
     assert state is not None and is_decode_dim(state, dim)
+    if state.width != 1:
+        # same rollback argument as running_sum: the rolling window is
+        # sequence-recurrent state a rejected draft position would corrupt
+        raise NotImplementedError(
+            "multi-position decode (speculative verify) does not support "
+            "causal-conv window caches — their rolling state cannot be "
+            "rolled back on draft rejection")
     ctx = scope.current()
     name = "cache/" + ctx.full_name("convwin")
     axis = x.axis(dim)

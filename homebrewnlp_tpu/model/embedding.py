@@ -104,12 +104,18 @@ def _embed(args: BlockArgs, shape: SHAPE) -> NamedTensor:
             assert not any(d.name == "batch" for d in out_dims), out_dims
             i = sliced_axes[0]
             axis = out_dims.index(full_shape[i])
-            data = jnp.take(data, state.pos[:, None], axis=axis)
+            # a width-m verify slice gathers rows pos + [0..m) per slot
+            # (speculative decoding); width 1 keeps the original indices
+            idx = state.pos[:, None]
+            if shape[i].size != 1:
+                idx = idx + jnp.arange(shape[i].size)
+            data = jnp.take(data, idx, axis=axis)
             out_dims[axis:axis + 1] = [params.batch_dim, shape[i]]
             return nt(data, out_dims)
         for i in sliced_axes:
             axis = out_dims.index(full_shape[i])
-            data = jax.lax.dynamic_slice_in_dim(data, state.pos, 1, axis=axis)
+            data = jax.lax.dynamic_slice_in_dim(data, state.pos,
+                                                shape[i].size, axis=axis)
             out_dims[axis] = shape[i]
         return nt(data, out_dims)
     position_dims = shape_sub(shape_sub(shape, params.feature_dims), params.intermediate)

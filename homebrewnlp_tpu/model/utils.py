@@ -93,12 +93,19 @@ def compare_range(params: ModelParameter, dim0: Dim, dim1: Dim,
             if decode.is_vector_pos(state.pos):
                 # continuous-batching engine: each slot sits at its own
                 # position, so the query range is per-row — masks gain a
-                # batch dim and broadcast by name downstream
+                # batch dim and broadcast by name downstream.  A width-m
+                # verify slice (speculative decoding) evaluates the range
+                # as pos + [0..m); width 1 keeps the original expression
                 assert state.pos.shape[0] == params.batch_dim.size, \
                     (state.pos.shape, params.batch_dim)
-                return nt(state.pos[:, None].astype(jnp.int32),
-                          [params.batch_dim, d])
-            return nt(state.pos[None].astype(jnp.int32), [d])
+                base = state.pos[:, None]
+                if d.size != 1:
+                    base = base + jnp.arange(d.size)
+                return nt(base.astype(jnp.int32), [params.batch_dim, d])
+            base = state.pos[None]
+            if d.size != 1:
+                base = base + jnp.arange(d.size)
+            return nt(base.astype(jnp.int32), [d])
         return range_(d, jnp.int32)
 
     return cast(comparison(_range(dim0), _range(dim1)),

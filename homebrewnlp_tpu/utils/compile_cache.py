@@ -16,25 +16,122 @@ of chunk-step variants, each fast to compile but slow in aggregate).
 tests/continuous_batching_test.py asserts a second in-process build of the
 same program HITS the cache (entries appear on the first compile, none are
 added by the second after ``jax.clear_caches()``).
+
+**Reload-broken environments** (docs/PERFORMANCE.md 'Round 11'): on
+jax-0.4.37's CPU backend, DESERIALIZING a cached train-step executable on a
+warm relaunch corrupts the heap (SIGSEGV/SIGABRT) — the cold run that
+POPULATES the cache works, so the knob looks fine until the restart it
+exists to speed up dies.  ``bench.py --compile-probe`` classifies this
+structurally and, when probing a persistent cache dir, records the verdict
+into ``<cache_dir>/compile_probe_verdict.json``
+(:func:`record_reload_verdict`).  ``install_compile_cache`` reads that
+verdict: a matching backend + jax version marked broken REFUSES to enable
+the cache with a loud structured warning instead of letting the warm
+relaunch crash — graceful degradation to cold compiles, not a mystery
+segfault (tests/spec_decode_test.py pins the refusal).
 """
 from __future__ import annotations
 
+import json
 import os
 import typing
+import warnings
+
+#: the probe's verdict marker inside a persistent cache dir
+VERDICT_FILE = "compile_probe_verdict.json"
+
+
+def _env_fingerprint() -> typing.Tuple[str, str]:
+    """(backend, jax_version) WITHOUT initialising jax's backends — the
+    install runs before ``jax.distributed`` bootstrap on multi-host, where
+    touching ``jax.default_backend()`` would bind the wrong topology."""
+    import jax
+    backend = (os.environ.get("JAX_PLATFORMS") or "default").split(",")[0]
+    return backend or "default", jax.__version__
+
+
+def record_reload_verdict(cache_dir: str, broken: bool,
+                          evidence: str = "") -> str:
+    """Write the compile-probe's warm-reload verdict into ``cache_dir``.
+
+    ``bench.py --compile-probe`` calls this after classifying the warm
+    relaunch; operators arm the guard by probing the deployment's actual
+    ``compile_cache_dir`` once.  Returns the verdict path."""
+    backend, jax_version = _env_fingerprint()
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, VERDICT_FILE)
+    with open(path, "w") as f:
+        json.dump({"backend": backend, "jax_version": jax_version,
+                   "reload_broken": bool(broken), "evidence": evidence}, f,
+                  indent=1)
+    return path
+
+
+def read_reload_verdict(cache_dir: str) -> typing.Optional[dict]:
+    """The recorded verdict, or None (no probe ran / unreadable file —
+    unreadable is treated as no evidence, never as broken)."""
+    try:
+        with open(os.path.join(cache_dir, VERDICT_FILE)) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _reload_refusal(path: str) -> typing.Optional[dict]:
+    """The verdict blocking installation for THIS environment, if any: the
+    probe must have marked reload broken for the same jax version (an
+    upgrade invalidates the classification — re-probe) and a COMPATIBLE
+    backend.  "default" (JAX_PLATFORMS unset) matches any recorded
+    backend and vice versa: the fingerprint is read without initialising
+    jax's backends, so an unset variable is "unknown", and refusing on
+    unknown is the safe direction — the cost of a false refusal is cold
+    compiles, the cost of a false install is the warm-relaunch segfault
+    this guard exists for."""
+    verdict = read_reload_verdict(path)
+    if not verdict or not verdict.get("reload_broken"):
+        return None
+    backend, jax_version = _env_fingerprint()
+    if verdict.get("jax_version") != jax_version:
+        return None
+    recorded = verdict.get("backend") or "default"
+    if recorded != backend and "default" not in (recorded, backend):
+        return None
+    return verdict
 
 
 def install_compile_cache(params_or_dir) -> typing.Optional[str]:
     """Point jax's persistent compilation cache at the configured directory.
 
     Accepts a ``ModelParameter`` (reads ``compile_cache_dir``) or a path
-    string; returns the installed path, or None when the knob is off.
-    Idempotent — safe to call from every entry point that might run first.
+    string; returns the installed path, or None when the knob is off — or
+    when ``bench.py --compile-probe`` has classified this backend + jax
+    version as RELOAD-BROKEN for this cache dir (loud structured warning;
+    the warm relaunch would segfault deserializing the cache, so cold
+    compiles are the fast path that actually finishes).  Idempotent — safe
+    to call from every entry point that might run first.
     """
     path = getattr(params_or_dir, "compile_cache_dir", params_or_dir)
     if not path:
         return None
     path = os.path.abspath(os.path.expanduser(str(path)))
     os.makedirs(path, exist_ok=True)
+    # the probe's own subprocesses must BYPASS the refusal: re-probing an
+    # armed dir has to actually exercise the cache to find out whether a
+    # jax upgrade fixed the reload — refusing inside the probe would
+    # measure two uncached runs and record a vacuous "healthy"
+    ignore = os.environ.get("HBNLP_COMPILE_CACHE_IGNORE_VERDICT") == "1"
+    refusal = None if ignore else _reload_refusal(path)
+    if refusal is not None:
+        msg = ("compile_cache_dir REFUSED: bench.py --compile-probe "
+               f"classified backend={refusal.get('backend')!r} "
+               f"jax={refusal.get('jax_version')!r} as reload-broken for "
+               f"{path!r} ({refusal.get('evidence') or 'no evidence text'}); "
+               "serving cold compiles instead of crashing the warm "
+               "relaunch.  Re-probe after a jax upgrade to re-enable.")
+        print("WARNING: " + msg, flush=True)
+        warnings.warn(msg)
+        return None
     import jax
     # persist EVERYTHING: the default min-compile-time (~1s) skips the
     # decode chunk steps this exists for

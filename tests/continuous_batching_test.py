@@ -468,3 +468,52 @@ def compile_cache_second_build_hits_test(tmp_path):
     class _Off:
         compile_cache_dir = ""
     assert install_compile_cache(_Off()) is None
+
+
+def compile_cache_reload_broken_refusal_test(tmp_path):
+    """A reload-broken probe verdict (the jax-0.4.37 CPU warm-cache
+    segfault, classified by ``bench.py --compile-probe``) makes
+    install_compile_cache REFUSE the persistent cache for that backend +
+    jax version with a loud structured warning — graceful degradation to
+    cold compiles, not a warm-relaunch crash.  A different jax version or
+    a healthy re-probe re-enables it."""
+    import warnings as warnings_mod
+    from homebrewnlp_tpu.utils import compile_cache as cc
+
+    cache = str(tmp_path / "xla-cache")
+
+    class _P:
+        compile_cache_dir = cache
+
+    try:
+        # no verdict: installs normally
+        assert cc.install_compile_cache(_P()) == cache
+        cc.uninstall_compile_cache()
+        # a broken verdict for THIS env refuses, loudly
+        path = cc.record_reload_verdict(cache, True,
+                                        evidence="injected by test")
+        assert path.endswith(cc.VERDICT_FILE)
+        with warnings_mod.catch_warnings(record=True) as caught:
+            warnings_mod.simplefilter("always")
+            assert cc.install_compile_cache(_P()) is None
+        assert any("reload-broken" in str(w.message) for w in caught)
+        # verdicts are env-scoped: a different jax version installs fine
+        # (an upgrade invalidates the classification — re-probe)
+        import json as json_mod
+        with open(path) as f:
+            verdict = json_mod.load(f)
+        verdict["jax_version"] = "999.0.0"
+        with open(path, "w") as f:
+            json_mod.dump(verdict, f)
+        assert cc.install_compile_cache(_P()) == cache
+        cc.uninstall_compile_cache()
+        # a healthy re-probe clears the refusal
+        cc.record_reload_verdict(cache, True, evidence="stale")
+        cc.record_reload_verdict(cache, False, evidence="healthy re-probe")
+        assert cc.install_compile_cache(_P()) == cache
+        # unreadable verdict = no evidence, never "broken"
+        with open(path, "w") as f:
+            f.write("{not json")
+        assert cc.install_compile_cache(_P()) == cache
+    finally:
+        cc.uninstall_compile_cache()

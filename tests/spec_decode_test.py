@@ -1,0 +1,455 @@
+"""Speculative decoding on the slot engine (marker: specdecode;
+docs/SERVING.md 'Speculative decoding').
+
+Substrate: a width-m ``apply_decode`` (the spec VERIFY step) must compute
+the same function as m sequential width-1 steps — same argmax, same KV
+rows — and models with sequence-recurrent caches must REFUSE multi-position
+decode (their state cannot roll back on draft rejection).
+
+Engine: greedy bit-parity of the draft-and-verify executor against the
+plain engine token-for-token, through three regimes — a PERFECT draft (the
+target itself: full acceptance incl. bonus tokens), a deliberately-bad
+random draft (acceptance ~0: every round survives on the verify's own
+token), and the acceptance-collapse self-disable (loud event, permanent
+reversion to the plain chunk program, still bit-correct).  Mixed
+co-residency (greedy + temperature>0 at draft depth 0) answers correctly.
+
+Analysis: the spec chunk step's compiled module — every leaf of BOTH cache
+pools donated+aliased, no full-pool-shaped copy (the graft-lint
+``spec_chunk_step`` audit).
+
+End to end: a real-IPC REST roundtrip on ``spec_decode="auto"`` with an
+attached draft, asserting answers match the direct interface call and the
+``hbnlp_spec_*`` acceptance series scrape on /metrics.
+
+Standalone-runnable (tier-1 truncates at 870s on this box):
+``python -m pytest tests/spec_decode_test.py -q``
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from backend import MIXER_BLOCKS, make_params
+from homebrewnlp_tpu.infer.scheduler import (EngineController, EngineRequest,
+                                             SlotScheduler, spec_depth)
+
+pytestmark = pytest.mark.specdecode
+
+SEQ = 32
+PROMPTS = [[1, 2, 3], [7, 8], [4, 5, 6, 7, 9], [10]]
+RLS = [6, 20, 3, None]
+
+
+def _interface(**kw):
+    from homebrewnlp_tpu.infer.interface import InterfaceWrapper
+    from homebrewnlp_tpu.model import Model
+    import jax.numpy as jnp
+    cfg = dict(block_config=MIXER_BLOCKS, memory_reduction_strategy="none",
+               sequence_length=SEQ, train_batch_size=1,
+               decode_loop="stepped", decode_chunk_tokens=5)
+    cfg.update(kw)
+    params = make_params(**cfg)
+    params.train = False
+    model = Model(params)
+    seq = params.sequence_dim.size
+    batch = {"token_x": np.zeros((1, seq, 1), np.int32),
+             "token_y": np.zeros((1, seq, 1), np.int32)}
+    variables = {k: jnp.asarray(v) for k, v in model.init(batch).items()}
+    return InterfaceWrapper(params, model, variables)
+
+
+def _draft_triple(features_per_head=8, seed_cfg=()):
+    """A narrow draft at the harness scale (fph 8 is the narrowest width
+    the factorized vocab supports) — random init, so acceptance ~0."""
+    from homebrewnlp_tpu.model import Model
+    import jax.numpy as jnp
+    cfg = dict(block_config=MIXER_BLOCKS, memory_reduction_strategy="none",
+               sequence_length=SEQ, train_batch_size=1,
+               features_per_head=features_per_head)
+    cfg.update(dict(seed_cfg))
+    dparams = make_params(**cfg)
+    dparams.train = False
+    dmodel = Model(dparams)
+    zeros = np.zeros((1, SEQ, 1), np.int32)
+    dvars = {k: jnp.asarray(v) for k, v in
+             dmodel.init({"token_x": zeros, "token_y": zeros}).items()}
+    return dparams, dmodel, dvars
+
+
+def _controller(ex, answers, events=None, slots=4):
+    sched = SlotScheduler(slots)
+    return EngineController(
+        ex, sched, decode_chunk=5, prefill_chunk=8,
+        answer=lambda req, oc: answers.__setitem__(req.rid, oc),
+        hooks=(lambda event, **k: events.append((event, k)))
+        if events is not None else None), sched
+
+
+def _run(ctl, answers, want, budget=80):
+    for _ in range(budget):
+        if all(r in answers for r in want):
+            return
+        ctl.round()
+    raise AssertionError(f"unanswered: {set(want) - set(answers)}")
+
+
+# ------------------------------------------------------- substrate parity
+
+def multiposition_verify_matches_sequential_test():
+    """Width-m apply_decode == m sequential width-1 steps: same logits (to
+    float-reassociation ulps), same argmax, same KV cache rows."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.model import Model
+    params = make_params(block_config=MIXER_BLOCKS,
+                         memory_reduction_strategy="none",
+                         sequence_length=SEQ, train_batch_size=4)
+    params.train = False
+    model = Model(params)
+    zeros = np.zeros((4, SEQ, 1), np.int32)
+    variables = {k: jnp.asarray(v) for k, v in
+                 model.init({"token_x": zeros, "token_y": zeros}).items()}
+    from homebrewnlp_tpu.infer.sampler import decode_cache_shapes
+    rng = np.random.default_rng(0)
+    token_x = jnp.asarray(rng.integers(0, params.vocab_size,
+                                       (4, SEQ, 1)).astype(np.int32))
+    shapes = decode_cache_shapes(model, variables,
+                                 np.zeros((4, SEQ, 1), np.int32))
+    zeros = {k: jnp.zeros(v.shape, v.dtype) for k, v in shapes.items()}
+    q0 = jnp.asarray(np.array([0, 2, 5, 1], np.int32))
+    m = 5
+    seq_logits, c = [], zeros
+    for i in range(m):
+        pos = q0 + i
+        cur = jnp.take_along_axis(token_x, pos[:, None, None], axis=1)
+        lg, c = model.apply_decode(variables, cur, pos, c)
+        seq_logits.append(np.asarray(lg))
+    seq_logits = np.concatenate(seq_logits, axis=1)
+    vtok = jnp.take_along_axis(
+        token_x, (q0[:, None] + jnp.arange(m))[:, :, None], axis=1)
+    ver_logits, vc = model.apply_decode(variables, vtok, q0, zeros)
+    ver_logits = np.asarray(ver_logits)
+    np.testing.assert_allclose(seq_logits, ver_logits, atol=1e-5)
+    assert (seq_logits.argmax(-1) == ver_logits.argmax(-1)).all()
+    for k in c:
+        np.testing.assert_allclose(np.asarray(c[k], np.float32),
+                                   np.asarray(vc[k], np.float32), atol=1e-4)
+
+
+def recurrent_caches_refuse_multiposition_test():
+    """A cumsum-mixing model must refuse width>1 decode (rollback is
+    impossible for running state) — the guard the spec executor's
+    construction probe relies on for its auto-fallback."""
+    import jax
+    import jax.numpy as jnp
+    blocks = [{"layer": ["norm-shift-scale-features-group", "cumsum"]}]
+    iface = _interface(block_config=blocks)
+    from homebrewnlp_tpu.infer.sampler import decode_cache_shapes
+    shapes = decode_cache_shapes(iface.model, iface.variables,
+                                 np.zeros((1, SEQ, 1), np.int32))
+    aval = jax.ShapeDtypeStruct
+    with pytest.raises(NotImplementedError, match="cumsum"):
+        jax.eval_shape(
+            lambda v, t, c: iface.model.apply_decode(
+                v, t, jnp.zeros(1, jnp.int32), c),
+            iface.variables, aval((1, 2, 1), jnp.int32),
+            {k: aval(v.shape, v.dtype) for k, v in shapes.items()})
+
+
+# --------------------------------------------------------- engine parity
+
+def spec_perfect_draft_bit_parity_test():
+    """With the target itself as draft, acceptance is ~100% (bonus-token
+    path exercised) and output matches the plain stepped loop
+    token-for-token — including late admission into a recycled slot."""
+    from homebrewnlp_tpu.infer.engine import SpecEngineExecutor
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    ref = [np.asarray(iface.complete_tokens(np.asarray(p, np.int32), 0.0,
+                                            rl))
+           for p, rl in zip(PROMPTS, RLS)]
+    ex = SpecEngineExecutor(iface, slots=4,
+                            draft=(iface.params, iface.model,
+                                   iface.variables))
+    answers, events = {}, []
+    ctl, _ = _controller(ex, answers, events)
+    ctl.round([EngineRequest(rid=f"r{i}", path="/token_completion",
+                             toks=np.asarray(p, np.int32), response_len=rl)
+               for i, (p, rl) in enumerate(zip(PROMPTS, RLS))])
+    _run(ctl, answers, [f"r{i}" for i in range(len(PROMPTS))])
+    for i, want in enumerate(ref):
+        kind, got = answers[f"r{i}"]
+        assert kind == "ok", (i, kind)
+        np.testing.assert_array_equal(np.asarray(got), want)
+    s = ex.spec_summary()
+    assert s["enabled"] and s["drafted"] > 0
+    assert s["accept_rate"] == 1.0, s      # the draft IS the target
+    verifies = [k for e, k in events if e == "spec_verify"]
+    assert verifies and all(v["accepted"] == v["drafted"] for v in verifies)
+    # late admission into a recycled slot (admit splice zeroes BOTH pools)
+    ctl.round([EngineRequest(rid="late", path="/token_completion",
+                             toks=np.asarray([3, 1, 4], np.int32),
+                             response_len=4)])
+    _run(ctl, answers, ["late"])
+    np.testing.assert_array_equal(
+        np.asarray(answers["late"][1]),
+        np.asarray(iface.complete_tokens(np.asarray([3, 1, 4], np.int32),
+                                         0.0, 4)))
+
+
+def spec_bad_draft_bit_parity_and_self_disable_test():
+    """A random draft (acceptance ~0) must still be bit-correct — every
+    round advances on the verify's own token — and must trip the
+    spec_min_accept_rate self-disable: loud event, hbnlp_spec_state flip
+    (scheduler forwards it), and the executor permanently reverts to the
+    plain chunk program, still serving bit-identically."""
+    from homebrewnlp_tpu.infer.engine import SpecEngineExecutor
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.5)
+    ref = [np.asarray(iface.complete_tokens(np.asarray(p, np.int32), 0.0,
+                                            rl))
+           for p, rl in zip(PROMPTS, RLS)]
+    ex = SpecEngineExecutor(iface, slots=4, draft=_draft_triple())
+    answers, events = {}, []
+    ctl, _ = _controller(ex, answers, events)
+    ctl.round([EngineRequest(rid=f"r{i}", path="/token_completion",
+                             toks=np.asarray(p, np.int32), response_len=rl)
+               for i, (p, rl) in enumerate(zip(PROMPTS, RLS))])
+    _run(ctl, answers, [f"r{i}" for i in range(len(PROMPTS))])
+    for i, want in enumerate(ref):
+        kind, got = answers[f"r{i}"]
+        assert kind == "ok", (i, kind)
+        np.testing.assert_array_equal(np.asarray(got), want)
+    disabled = [k for e, k in events if e == "spec_disabled"]
+    assert disabled and disabled[0]["rate"] < 0.5
+    assert not ex._spec_enabled
+    assert ex.spec_summary()["accept_rate"] < 0.5
+    # post-disable: the plain program serves the next request bit-identically
+    ctl.round([EngineRequest(rid="after", path="/token_completion",
+                             toks=np.asarray([3, 1, 4], np.int32),
+                             response_len=4)])
+    _run(ctl, answers, ["after"])
+    np.testing.assert_array_equal(
+        np.asarray(answers["after"][1]),
+        np.asarray(iface.complete_tokens(np.asarray([3, 1, 4], np.int32),
+                                         0.0, 4)))
+
+
+def spec_int8_kv_bit_parity_test():
+    """int8 KV composition: the verify's width-m scatter lands m quantized
+    rows AND m sibling scale rows per slot (per-position scales — the
+    width-m quantization of each row is the same per-row formula the
+    sequential walk applies), and the spec engine stays token-for-token
+    equal to the plain engine on the same int8 pool."""
+    from homebrewnlp_tpu.infer.engine import SpecEngineExecutor
+    iface = _interface(spec_draft_tokens=3, spec_min_accept_rate=0.0,
+                       decode_cache_dtype="int8")
+    prompts, rls = PROMPTS[:3], [6, 12, 3]
+    ref = [np.asarray(iface.complete_tokens(np.asarray(p, np.int32), 0.0,
+                                            rl))
+           for p, rl in zip(prompts, rls)]
+    ex = SpecEngineExecutor(iface, slots=3,
+                            draft=(iface.params, iface.model,
+                                   iface.variables))
+    answers = {}
+    ctl, _ = _controller(ex, answers, slots=3)
+    ctl.round([EngineRequest(rid=f"r{i}", path="/token_completion",
+                             toks=np.asarray(p, np.int32), response_len=rl)
+               for i, (p, rl) in enumerate(zip(prompts, rls))])
+    _run(ctl, answers, [f"r{i}" for i in range(len(prompts))])
+    for i, want in enumerate(ref):
+        kind, got = answers[f"r{i}"]
+        assert kind == "ok", (i, kind)
+        np.testing.assert_array_equal(np.asarray(got), want)
+    assert ex.spec_summary()["drafted"] > 0
+
+
+def spec_mixed_temperature_coresidency_test():
+    """temperature>0 requests ride the same verify at draft depth 0 (one
+    sampled token per round) co-resident with greedy spec rows; the greedy
+    row stays bit-identical and the sampled row answers with the right
+    extent."""
+    from homebrewnlp_tpu.infer.engine import SpecEngineExecutor
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    ex = SpecEngineExecutor(iface, slots=2,
+                            draft=(iface.params, iface.model,
+                                   iface.variables))
+    answers = {}
+    ctl, _ = _controller(ex, answers, slots=2)
+    ctl.round([EngineRequest(rid="g", path="/token_completion",
+                             toks=np.asarray([1, 2], np.int64),
+                             response_len=6),
+               EngineRequest(rid="t", path="/token_completion",
+                             toks=np.asarray([5], np.int64),
+                             response_len=6, temperature=0.8)])
+    _run(ctl, answers, ["g", "t"])
+    assert answers["g"][0] == "ok" and answers["t"][0] == "ok"
+    np.testing.assert_array_equal(
+        np.asarray(answers["g"][1]),
+        np.asarray(iface.complete_tokens(np.asarray([1, 2], np.int32),
+                                         0.0, 6)))
+    assert len(answers["t"][1]) == 1 + 6
+
+
+def spec_depth_eligibility_test():
+    """scheduler.spec_depth: greedy-with-default-filters drafts at k,
+    anything the accept rule cannot serve bit-identically drafts at 0."""
+    defaults = (0, 1.0, 1.0)
+    base = dict(rid="r", path="/token_completion", toks=np.asarray([1]))
+    assert spec_depth(EngineRequest(**base), defaults, 4) == 4
+    assert spec_depth(EngineRequest(**base, temperature=0.5), defaults,
+                      4) == 0
+    assert spec_depth(EngineRequest(**base, top_k=5), defaults, 4) == 0
+    assert spec_depth(EngineRequest(**base, top_p=0.9), defaults, 4) == 0
+    assert spec_depth(EngineRequest(**base, rep_penalty=1.2), defaults,
+                      4) == 0
+    # non-default CONFIG fallbacks disqualify requests that omit the knob
+    assert spec_depth(EngineRequest(**base), (5, 1.0, 1.0), 4) == 0
+
+
+def spec_draft_requires_continuous_engine_test():
+    """spec_decode="draft" promises speculation or no serving at all:
+    combined with serve_engine="batch" (which cannot speculate) the
+    resolver refuses loudly instead of silently serving without drafts."""
+    from homebrewnlp_tpu.infer import rest_api
+    iface = _interface(serve_engine="batch", spec_decode="draft")
+    with pytest.raises(RuntimeError, match="continuous"):
+        rest_api._resolve_engine(iface.params, iface)
+    # "auto" + batch is fine: speculate-when-possible never blocks serving
+    iface2 = _interface(serve_engine="batch", spec_decode="auto")
+    assert rest_api._resolve_engine(iface2.params, iface2) is None
+
+
+def load_draft_config_roundtrip_test(tmp_path):
+    """infer/spec.load_draft: a config-JSON draft builds at the target's
+    sequence geometry (no checkpoint -> loud random-init note), and a
+    geometry mismatch refuses with a named error."""
+    from homebrewnlp_tpu.infer import spec as spec_mod
+    iface = _interface()
+    cfg = {"model_mode": "gpt", "use_video": False, "use_language": True,
+           "sequence_length": 64,  # overridden to the target's geometry
+           "features_per_head": 8, "heads": 2, "depth": 2,
+           "train_batch_size": 1, "vocab_size": 32,
+           "group_linear_factor": 2,
+           "intermediate_feed_forward_multiplier_multiplier": 0.5,
+           "block_config": MIXER_BLOCKS,
+           "memory_reduction_strategy": "none",
+           "model_path": str(tmp_path / "draft_run")}
+    cfg_path = tmp_path / "draft.json"
+    cfg_path.write_text(json.dumps(cfg))
+    iface.params.spec_draft_model_path = str(cfg_path)
+    dparams, dmodel, dvars = spec_mod.load_draft(iface.params)
+    assert dparams.sequence_length == iface.params.sequence_length
+    assert dparams.vocab_size == iface.params.vocab_size
+    assert dvars  # initialised (random — no checkpoint committed here)
+    # geometry mismatch: a draft over a different vocabulary must refuse
+    bad = dict(cfg, vocab_size=64)
+    from homebrewnlp_tpu.config import ModelParameter
+    with pytest.raises(ValueError, match="vocab_size"):
+        spec_mod.check_draft_compatible(iface.params, ModelParameter(bad))
+
+
+# ------------------------------------------------------------- HLO audit
+
+def spec_hlo_audit_test():
+    """The spec chunk step's compiled module: every leaf of BOTH cache
+    pools (target + draft) donated+aliased, no full-pool-shaped copy —
+    enforced repo-wide by graft-lint as spec_chunk_step."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.analysis import entry_points, hlo_lint
+    params, model, variables, token_x, _ = entry_points.build_audit_model()
+    hlo, ctx = entry_points.lower_spec_step(model, variables,
+                                            jnp.asarray(token_x))
+    assert hlo_lint.input_output_alias_count(hlo) >= ctx["donated_leaves"]
+    # both pools contribute leaves: the carry donates more than the plain
+    # engine's single pool
+    assert ctx["donated_leaves"] > 3 + len(
+        [k for k in ctx["cache_shapes"] if not k.startswith("draft/")])
+    findings = hlo_lint.audit("spec_chunk_step", hlo,
+                              expected_aliases=ctx["donated_leaves"],
+                              protected_shapes=ctx["protected"],
+                              bf16_param_shapes=ctx["bf16_params"],
+                              budget={})
+    assert findings == [], [str(f) for f in findings]
+
+
+# ------------------------------------------------------- REST roundtrip
+
+def spec_rest_roundtrip_test():
+    """End to end over real IPC with spec_decode=auto and an attached
+    draft: completions bit-match the direct interface call, /health
+    reports the spec engine, and the acceptance series scrape on
+    /metrics."""
+    import socket
+    from homebrewnlp_tpu.infer import rest_api
+    iface = _interface(serve_engine="continuous", serve_slots=4,
+                       serve_batch_size=4, spec_decode="auto",
+                       spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    # perfect draft (the target) so the scrape shows real acceptance
+    iface.draft = (iface.params, iface.model, iface.variables)
+    ref = np.asarray(iface.complete_tokens(np.asarray([1, 2, 3], np.int32),
+                                           0.0, 6))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stop = threading.Event()
+    t = threading.Thread(target=rest_api.serve,
+                         args=(iface.params, iface),
+                         kwargs={"port": port, "isolate": True,
+                                 "stop": stop},
+                         daemon=True)
+    t.start()
+
+    def post(path, payload, timeout=120):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        for _ in range(240):
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+            except (ConnectionError, urllib.error.URLError, OSError):
+                time.sleep(0.25)
+        raise TimeoutError(path)
+
+    try:
+        status, health = post("/health", {})
+        assert status == 200
+        engine = health["engine"]
+        assert engine["mode"] == "continuous" and engine["slots"] == 4
+        assert engine["spec"]["enabled"] and \
+            engine["spec"]["draft_tokens"] == 4
+        status, out = post("/token_completion",
+                           {"tokens": [1, 2, 3], "max_tokens": 6,
+                            "temperature": 0.0})
+        assert status == 200
+        assert out["tokens"] == [int(x) for x in ref]
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+        deadline = time.monotonic() + 30
+        while True:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                text = resp.read().decode()
+            if "hbnlp_spec_accepted_tokens_total" in text:
+                break
+            assert time.monotonic() < deadline, text[:2000]
+            time.sleep(0.5)
+        assert "hbnlp_spec_state 1" in text
+        assert "hbnlp_spec_accept_rate_bucket" in text
+        assert "hbnlp_spec_accepted_tokens_per_verify" in text
+        # perfect draft: every drafted token accepted
+        drafted = [ln for ln in text.splitlines()
+                   if ln.startswith("hbnlp_spec_drafted_tokens_total")]
+        accepted = [ln for ln in text.splitlines()
+                    if ln.startswith("hbnlp_spec_accepted_tokens_total")]
+        assert drafted and accepted
+        assert float(drafted[0].split()[-1]) == \
+            float(accepted[0].split()[-1]) > 0
+    finally:
+        stop.set()
+        t.join(timeout=15)
+    assert not t.is_alive()
