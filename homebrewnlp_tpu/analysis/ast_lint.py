@@ -78,6 +78,10 @@ DONATED_JIT_REGISTRY: typing.Dict[str, str] = {
     # spec_plain share one jit site; BOTH cache pools ride the donated
     # carry and are audited as "spec_chunk_step")
     "homebrewnlp_tpu/infer/engine.py::_spec_jit": "spec_chunk_step",
+    # the paged-KV engine chunk step (paged_init/paged_admit/paged_plain
+    # share one jit site; the KV block pools ride the donated carry and
+    # are audited as "paged_chunk_step")
+    "homebrewnlp_tpu/infer/paged.py::_paged_jit": "paged_chunk_step",
 }
 
 

@@ -54,7 +54,13 @@ AUDIT_CONFIG: typing.Dict[str, typing.Any] = {
 
 #: audited entry points, in budgets.json key order
 ENTRY_POINTS = ("train_step", "decode_chunk_step", "prefill_entry_step",
-                "eval_fn", "engine_chunk_step", "spec_chunk_step")
+                "eval_fn", "engine_chunk_step", "spec_chunk_step",
+                "paged_chunk_step")
+
+#: KV block size for the paged-engine audit: a real multi-block geometry
+#: (seq 16 -> 4 blocks/slot) so the table gather/scatter machinery is
+#: present in the audited module, not degenerate single-block paging
+PAGED_AUDIT_BLOCK_TOKENS = 4
 
 #: the speculative DRAFT at audit scale: the same model definition at a
 #: smaller width (the one-graph-many-layouts rule the production draft
@@ -299,6 +305,68 @@ def lower_engine_step(model, variables, token_x, mesh=None):
     return hlo, context
 
 
+def lower_paged_step(model, variables, token_x, mesh=None):
+    """Compiled donated PAGED engine chunk step (``infer/paged.py``
+    ``_paged_jit`` kind ``paged_plain``): the donated carry holds the KV
+    BLOCK POOLS (per-leaf ``[num_blocks, block_tokens, ...]`` layouts plus
+    any resident recurrent leaves), and the chunk gathers per-slot views
+    through the read table, runs the shared engine loop, and scatters back
+    through the write table.  The audit pins every pool leaf aliased
+    input->output with no full-pool-shaped copy — the gather/scatter
+    round-trip must not cost a resident duplicate of the pool.
+
+    Abstract avals throughout, same OOM-safety argument as
+    ``lower_decode_step``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..infer.paged import _paged_jit, classify_cache_leaves
+    from ..infer.sampler import decode_cache_shapes
+
+    aval = jax.ShapeDtypeStruct
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    bt = PAGED_AUDIT_BLOCK_TOKENS if seq % PAGED_AUDIT_BLOCK_TOKENS == 0 \
+        else 1
+    seq_blocks = seq // bt
+    num_blocks = batch * seq_blocks
+    shapes = decode_cache_shapes(model, variables, token_x)
+    info = classify_cache_leaves(shapes, seq)
+    pools = {}
+    for n, s in shapes.items():
+        baxis, sax = info[n]
+        if sax is None:
+            pools[n] = aval(tuple(s.shape), s.dtype)
+        else:
+            ps = list(s.shape)
+            ps[baxis], ps[sax] = num_blocks, bt
+            pools[n] = aval(tuple(ps), s.dtype)
+    step = _paged_jit(model, mesh, "paged_plain", bt, num_blocks)
+    vec_i = aval((batch,), jnp.int32)
+    vec_f = aval((batch,), jnp.float32)
+    scalar = aval((), jnp.int32)
+    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
+    seen = aval((batch, model.params.vocab_size), jnp.float32)
+    table = aval((batch, seq_blocks), jnp.int32)
+    carry = (vec_i, aval(tuple(token_x.shape), token_x.dtype), pools, key,
+             seen)
+    fargs = (vec_i, vec_f, vec_f)
+    args = (variables, vec_i, vec_f, vec_i, scalar, fargs, (), table, table,
+            carry)
+    compiled = step.lower(*args).compile()
+    hlo = compiled.as_text()
+    context = {
+        # q + token_x + key + seen ride the donated carry next to the pools
+        "donated_leaves": len(pools) + 4,
+        "protected": hlo_lint.shape_strings(pools, key_filter="/kv"),
+        "cache_shapes": pools,
+        "bf16_params": hlo_lint.shape_strings(variables, min_rank=2,
+                                              dtypes={"bf16"}),
+        "compiled": compiled,
+        "trace": lambda: step.trace(*args).jaxpr,
+    }
+    return hlo, context
+
+
 def lower_spec_step(model, variables, token_x, draft_model=None,
                     draft_variables=None, mesh=None):
     """Compiled donated SPECULATIVE chunk step (``infer/engine.py``
@@ -396,6 +464,8 @@ def lower_all(overrides: typing.Optional[dict] = None
                                    trainer=trainer, state=state)
     out["engine_chunk_step"] = lower_engine_step(model, variables,
                                                  jnp.asarray(token_x))
+    out["paged_chunk_step"] = lower_paged_step(model, variables,
+                                               jnp.asarray(token_x))
     draft_overrides = dict(overrides or {})
     draft_overrides.update(DRAFT_AUDIT_OVERRIDES)
     _, dmodel, dvariables, _, _ = build_audit_model(draft_overrides, seed=1)
@@ -428,6 +498,8 @@ def lower_one(entry: str, overrides: typing.Optional[dict] = None
         return lower_decode_step(model, variables, jnp.asarray(token_x))
     if entry == "engine_chunk_step":
         return lower_engine_step(model, variables, jnp.asarray(token_x))
+    if entry == "paged_chunk_step":
+        return lower_paged_step(model, variables, jnp.asarray(token_x))
     if entry == "spec_chunk_step":
         # the draft shares the caller's overrides (sequence geometry must
         # match the target — the lower_all merge rule)
@@ -465,7 +537,8 @@ def audit_lowered(lowered: "typing.Dict[str, typing.Tuple[str, dict]]",
         budget=train_budget)
 
     for entry in ("decode_chunk_step", "prefill_entry_step",
-                  "engine_chunk_step", "spec_chunk_step"):
+                  "engine_chunk_step", "spec_chunk_step",
+                  "paged_chunk_step"):
         hlo, ctx = lowered[entry]
         findings += hlo_lint.audit(
             entry, hlo,

@@ -113,7 +113,7 @@ MESH_STRATEGIES: typing.Dict[str, MeshStrategy] = {
         "dp_tp",
         {"mesh_shape_override": {"data": 4, "model": 2}},
         entries=("train_step", "decode_chunk_step", "engine_chunk_step",
-                 "spec_chunk_step"),
+                 "spec_chunk_step", "paged_chunk_step"),
         sharded_dims={"heads": "model"},
         collective_axes=frozenset({"data", "model"}),
         description="2-D data x tensor parallelism (heads over 'model')"),
@@ -425,6 +425,13 @@ def lower_serving_under_mesh(strategy: MeshStrategy, entry: str,
     elif entry == "engine_chunk_step":
         hlo, ctx = entry_points.lower_engine_step(model, var_avals, tok,
                                                   mesh=mesh)
+    elif entry == "paged_chunk_step":
+        # the paged pools inherit the KV layout constraints through the
+        # same _constrain_cache path as the slot pool (the views are
+        # constrained in-loop; the pools are their storage), so the audit
+        # covers the sharded serving shape of the paged program
+        hlo, ctx = entry_points.lower_paged_step(model, var_avals, tok,
+                                                 mesh=mesh)
     elif entry == "spec_chunk_step":
         # the draft rides the same strategy at DRAFT_AUDIT_OVERRIDES width;
         # its param avals carry the same layout-rule shardings as the

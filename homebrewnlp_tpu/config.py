@@ -389,6 +389,43 @@ class ModelParameter:
         # scheduling only happens at chunk boundaries.  Steady-state decode
         # uses decode_chunk_tokens
         self.serve_prefill_chunk_tokens = 128
+        # ---- paged KV cache + prefix sharing (docs/SERVING.md) ----
+        # replace the engine's fixed per-slot KV stripes with a block pool
+        # (infer/paged.py): device KV memory tracks live tokens instead of
+        # slots x worst-case length, and prompts sharing a cached prefix
+        # (the common-system-prompt chat pattern) reference the same blocks
+        # and skip prefill over the shared span (copy-on-write at the
+        # divergence point).  "off" = the plain slot engine, byte-identical
+        # to the pre-paging behavior; "on" = required (serving refuses to
+        # start when the geometry cannot page); "auto" = paged when the
+        # deployment can carry it, plain slot engine otherwise.  Greedy
+        # output is bit-identical to the plain engine either way
+        self.kv_paging = "off"
+        # tokens per KV block (the paging granularity): smaller tracks live
+        # tokens tighter and shares shorter prefixes; larger means fewer,
+        # cheaper table entries.  Must divide the sequence length in patches
+        self.kv_block_tokens = 16
+        # device block-pool capacity in blocks; 0 = auto
+        # (serve_slots x sequence_blocks — capacity parity with the slot
+        # engine).  Smaller pools oversubscribe the slots: admissions whose
+        # worst-case extent cannot be reserved QUEUE until blocks free up
+        # (never an error), and finished prompts stay cached in the radix
+        # tree as refcount-0 blocks until LRU eviction reclaims them
+        self.kv_pool_blocks = 0
+        # ---- multi-replica serving tier (docs/SERVING.md) ----
+        # N >= 2 serves THIS config as N engine replica processes behind a
+        # device-free router (infer/router.py + distributed/replica_fleet.py)
+        # doing prefix-affinity + least-loaded dispatch with a per-replica
+        # circuit breaker; the router port is the configured serving port,
+        # replicas bind the ports above it.  0/1 = single-replica serving
+        # (the pre-tier behavior, byte-identical)
+        self.serve_replicas = 0
+        # router-side prefix-affinity window: requests whose first N tokens
+        # match are routed to the same replica (maximizing its radix-tree
+        # hit rate) unless it is overloaded past serve_affinity_slack
+        # in-flight requests more than the least-loaded replica
+        self.serve_affinity_tokens = 32
+        self.serve_affinity_slack = 4
         # ---- speculative decoding on the slot engine (docs/SERVING.md) ----
         # draft-and-verify on the continuous engine: each slot runs k cheap
         # draft steps with a quarter-width draft model, then ONE width-(k+1)
@@ -524,6 +561,22 @@ class ModelParameter:
         if self.serve_prefill_chunk_tokens < 1:
             raise ValueError("serve_prefill_chunk_tokens must be >= 1, got "
                              f"{self.serve_prefill_chunk_tokens}")
+        # tri-state like serve_engine: a typo would silently serve through
+        # the wrong KV layout
+        if self.kv_paging not in ("off", "on", "auto"):
+            raise ValueError("kv_paging must be \"off\", \"on\" or "
+                             f"\"auto\", got {self.kv_paging!r}")
+        if self.kv_block_tokens < 1:
+            raise ValueError("kv_block_tokens must be >= 1, got "
+                             f"{self.kv_block_tokens}")
+        if self.kv_pool_blocks < 0:
+            raise ValueError("kv_pool_blocks must be >= 0 (0 = auto), got "
+                             f"{self.kv_pool_blocks}")
+        for knob in ("serve_replicas", "serve_affinity_tokens",
+                     "serve_affinity_slack"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0, got "
+                                 f"{getattr(self, knob)}")
         # tri-state like serve_engine: a typo would silently serve without
         # (or refuse to serve with) speculation
         if self.spec_decode not in ("off", "draft", "auto"):

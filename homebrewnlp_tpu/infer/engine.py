@@ -141,6 +141,57 @@ def _sample_logits(logits, seen, tb, fargs, key):
     return jnp.argmax(logits, axis=-1), key
 
 
+def _engine_loop(model: Model, mesh, variables, ipb, tb, end_pos, steps,
+                 fargs, q, token_x, caches, key, seen):
+    """The engine's decode while-loop: up to ``steps`` live iterations of
+    (read token at q -> apply_decode -> sample -> write q+1 past the prompt
+    boundary).  ONE definition shared by the plain slot engine
+    (``_engine_jit``) and the paged engine (``infer/paged.py``) — the
+    paged-vs-plain greedy bit-parity contract cannot drift between copies
+    because there are no copies.  ``caches`` is whatever cache pytree the
+    caller carries (the fixed-slot pool, or the paged engine's gathered
+    per-slot views)."""
+    import jax
+    import jax.numpy as jnp
+
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    rows3 = jnp.arange(batch)[:, None, None]
+    end_pos = jnp.minimum(end_pos, seq)
+
+    def cond_fn(state):
+        it, qv = state[0], state[1]
+        return (it < steps) & jnp.any(qv < end_pos - 1)
+
+    def body_fn(state):
+        it, qv, token_x, caches, key, seen = state
+        active = qv < end_pos - 1
+        qc = jnp.clip(qv, 0, seq - 1)
+        cur = jnp.take_along_axis(token_x, qc[:, None, None], axis=1)
+        logits, caches = model.apply_decode(variables, cur, qc, caches,
+                                            mesh=mesh)
+        with jax.named_scope("sampling"):
+            nxt, key = _sample_logits(logits, seen, tb, fargs, key)
+            nxt = nxt.astype(token_x.dtype)
+            qp1 = qc + 1
+            old = jnp.take_along_axis(
+                token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
+            # write q+1 only for rows that are live AND past their own
+            # prompt boundary — walking rows keep consuming their prompt
+            write = active & (qp1 >= ipb)
+            new = jnp.where(write[:, None, None], nxt, old)
+            token_x = token_x.at[jnp.arange(batch), qp1].set(
+                jnp.squeeze(new, 1), mode="drop")
+        seen = seen.at[rows3, new].add(
+            write.astype(jnp.float32)[:, None, None])
+        qv = qv + active.astype(qv.dtype)
+        return it + 1, qv, token_x, caches, key, seen
+
+    state = (jnp.int32(0), q, token_x, caches, key, seen)
+    _, q, token_x, caches, key, seen = jax.lax.while_loop(
+        cond_fn, body_fn, state)
+    return q, token_x, caches, key, seen
+
+
 def _engine_jit(model: Model, mesh, kind: str):
     """Per-model cache of the jitted engine steps (mirrors
     ``sampler._jit_sampler`` — a fresh closure per dispatch would re-trace
@@ -168,8 +219,6 @@ def _engine_jit(model: Model, mesh, kind: str):
                       decode_cache_shapes(model, variables, token_x).items()}
         else:
             q, token_x, caches, key, seen = carry
-        batch, seq = token_x.shape[0], token_x.shape[1]
-        rows3 = jnp.arange(batch)[:, None, None]
         if admit:
             mask, new_rows = admit_args
             q = jnp.where(mask, jnp.zeros_like(q), q)
@@ -178,40 +227,8 @@ def _engine_jit(model: Model, mesh, kind: str):
                 () if init_caches else (caches,))
             if not init_caches:
                 caches, = pools
-        end_pos = jnp.minimum(end_pos, seq)
-
-        def cond_fn(state):
-            it, qv = state[0], state[1]
-            return (it < steps) & jnp.any(qv < end_pos - 1)
-
-        def body_fn(state):
-            it, qv, token_x, caches, key, seen = state
-            active = qv < end_pos - 1
-            qc = jnp.clip(qv, 0, seq - 1)
-            cur = jnp.take_along_axis(token_x, qc[:, None, None], axis=1)
-            logits, caches = model.apply_decode(variables, cur, qc, caches,
-                                                mesh=mesh)
-            with jax.named_scope("sampling"):
-                nxt, key = _sample_logits(logits, seen, tb, fargs, key)
-                nxt = nxt.astype(token_x.dtype)
-                qp1 = qc + 1
-                old = jnp.take_along_axis(
-                    token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
-                # write q+1 only for rows that are live AND past their own
-                # prompt boundary — walking rows keep consuming their prompt
-                write = active & (qp1 >= ipb)
-                new = jnp.where(write[:, None, None], nxt, old)
-                token_x = token_x.at[jnp.arange(batch), qp1].set(
-                    jnp.squeeze(new, 1), mode="drop")
-            seen = seen.at[rows3, new].add(
-                write.astype(jnp.float32)[:, None, None])
-            qv = qv + active.astype(qv.dtype)
-            return it + 1, qv, token_x, caches, key, seen
-
-        state = (jnp.int32(0), q, token_x, caches, key, seen)
-        _, q, token_x, caches, key, seen = jax.lax.while_loop(
-            cond_fn, body_fn, state)
-        return q, token_x, caches, key, seen
+        return _engine_loop(model, mesh, variables, ipb, tb, end_pos, steps,
+                            fargs, q, token_x, caches, key, seen)
 
     # the carry (argument 7) is DONATED: every cache-pool leaf must alias
     # input->output — the invariant graft-lint's engine_chunk_step audit

@@ -181,6 +181,41 @@ def _serve_metrics() -> dict:
                 "hbnlp_spec_disabled_total",
                 "acceptance-collapse self-disables (the engine reverted to "
                 "the plain continuous program)"),
+            # paged KV block pool (docs/SERVING.md 'Paged KV'): occupancy
+            # gauges that prove device KV memory tracks LIVE tokens (not
+            # slots x worst-case length), plus the prefix-sharing economics
+            "kv_blocks_total": r.gauge(
+                "hbnlp_kv_blocks_total",
+                "device KV block-pool capacity (kv_pool_blocks resolved)"),
+            "kv_blocks_free": r.gauge(
+                "hbnlp_kv_blocks_free",
+                "KV blocks on the free list (unallocated pool capacity)"),
+            "kv_blocks_in_use": r.gauge(
+                "hbnlp_kv_blocks_in_use",
+                "KV blocks referenced by resident requests — the live-token "
+                "device footprint"),
+            "kv_blocks_cached": r.gauge(
+                "hbnlp_kv_blocks_cached",
+                "refcount-0 blocks held by the radix prefix cache "
+                "(reusable by future prefix hits, LRU-evicted on demand)"),
+            "kv_prefix_lookups": r.counter(
+                "hbnlp_kv_prefix_lookups_total",
+                "admissions that consulted the radix prefix tree"),
+            "kv_prefix_hits": r.counter(
+                "hbnlp_kv_prefix_hits_total",
+                "admissions that matched a cached prefix and skipped "
+                "prefill over the shared span"),
+            "kv_prefix_hit_tokens": r.counter(
+                "hbnlp_kv_prefix_hit_tokens_total",
+                "prompt tokens served from shared blocks instead of "
+                "prefill"),
+            "kv_cow_copies": r.counter(
+                "hbnlp_kv_cow_copies_total",
+                "copy-on-write block copies at prefix divergence points"),
+            "kv_tree_evictions": r.counter(
+                "hbnlp_kv_tree_evictions_total",
+                "LRU evictions of refcount-0 radix-cached blocks to refill "
+                "the free list"),
         }
     return _SERVE_METRICS
 
@@ -966,6 +1001,15 @@ def _resolve_engine(params: ModelParameter, interface):
     models, layers without a streaming form)."""
     mode = str(getattr(params, "serve_engine", "auto") or "auto")
     spec_mode = str(getattr(params, "spec_decode", "off") or "off")
+    paging = str(getattr(params, "kv_paging", "off") or "off")
+    if mode == "batch" and paging == "on":
+        # "on" promises paged serving or no serving at all; the batch
+        # engine has no block pool — a config contradiction, like
+        # spec_decode="draft" + serve_engine="batch"
+        raise RuntimeError(
+            "kv_paging=\"on\" requires the continuous engine, but "
+            "serve_engine=\"batch\" disables it — set serve_engine to "
+            "\"auto\"/\"continuous\" or kv_paging to \"off\"/\"auto\"")
     if mode == "batch":
         if spec_mode == "draft":
             # "draft" promises speculation or no serving at all; the batch
@@ -978,6 +1022,38 @@ def _resolve_engine(params: ModelParameter, interface):
                 "\"auto\"/\"continuous\" or spec_decode to \"off\"/\"auto\"")
         return None
     slots = max(1, int(getattr(params, "serve_slots", 8) or 1))
+    if paging != "off" and spec_mode == "draft":
+        # both knobs demand their own chunk program and the paged spec
+        # composition does not exist yet — refuse the contradiction loudly
+        # instead of silently dropping one of two explicit requirements
+        raise RuntimeError(
+            "kv_paging and spec_decode=\"draft\" cannot be combined yet — "
+            "the speculative engine runs on the fixed-slot pool; set one "
+            "of the two knobs to \"off\"/\"auto\"")
+    if paging != "off":
+        from .paged import PagedEngineExecutor
+        try:
+            # NotImplementedError is the ONE auto-fallback signal (geometry
+            # the pool cannot carry); an explicit misconfiguration
+            # (ValueError, e.g. a kv_pool_blocks too small for one request)
+            # or a genuine bug must surface, not silently serve unpaged
+            executor = PagedEngineExecutor(
+                interface, slots,
+                block_tokens=int(getattr(params, "kv_block_tokens", 16)),
+                pool_blocks=int(getattr(params, "kv_pool_blocks", 0) or 0))
+        except NotImplementedError as e:
+            if paging == "on":
+                raise RuntimeError(
+                    "kv_paging=\"on\" but the paged engine cannot serve "
+                    f"this deployment: {e!r}") from e
+            print(f"paged KV unavailable ({e!r}); serving the plain "
+                  "continuous engine")
+        else:
+            if spec_mode != "off":
+                print("kv_paging engaged; spec_decode=auto is skipped "
+                      "(the speculative engine runs on the fixed-slot "
+                      "pool)")
+            return executor
     if spec_mode != "off":
         # speculative decoding rides the continuous engine: build the draft
         # (bench/test callers attach a ready triple as interface.draft; the
@@ -1057,6 +1133,7 @@ def _engine_hooks_fn(interface, scheduler, executor):
     if spec:
         m["spec_state"].set(1)
     verifies = [0]
+    pool_seen: typing.Dict[str, int] = {}
 
     def hooks(event, **kw):
         # telemetry must never fail a decode round — but say so (the
@@ -1112,6 +1189,23 @@ def _engine_hooks_fn(interface, scheduler, executor):
         elif event == "spec_disabled":
             m["spec_disabled"].inc()
             m["spec_state"].set(0)
+        elif event == "pool":
+            m["kv_blocks_total"].set(int(kw.get("blocks_total") or 0))
+            m["kv_blocks_free"].set(int(kw.get("blocks_free") or 0))
+            m["kv_blocks_in_use"].set(int(kw.get("blocks_in_use") or 0))
+            m["kv_blocks_cached"].set(int(kw.get("blocks_cached") or 0))
+            # the executor reports cumulative pool stats; the counters
+            # export deltas so scrape-side rate() stays meaningful
+            for key, name in (("prefix_lookups", "kv_prefix_lookups"),
+                              ("prefix_hits", "kv_prefix_hits"),
+                              ("prefix_hit_tokens", "kv_prefix_hit_tokens"),
+                              ("cow_copies", "kv_cow_copies"),
+                              ("tree_evictions", "kv_tree_evictions")):
+                cur = int(kw.get(key) or 0)
+                delta = cur - pool_seen.get(key, 0)
+                if delta > 0:
+                    m[name].inc(delta)
+                pool_seen[key] = cur
         m["slots_occupied"].set(len(scheduler.resident))
 
     return hooks
@@ -1227,6 +1321,10 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
         # speculative engine: surface the acceptance economics on /health
         # (the live rate rides /metrics; this is the startup config view)
         engine_info["spec"] = executor.spec_summary()
+    if hasattr(executor, "pool_stats"):
+        # paged engine: block geometry + sharing mode on /health (live
+        # occupancy rides the hbnlp_kv_* /metrics gauges)
+        engine_info["paging"] = executor.pool_stats()
     state.update(model_loaded=True, decode_path=decode_path, inflight=0,
                  engine=engine_info)
     guard.publish(state, interface)

@@ -1,0 +1,427 @@
+"""Multi-replica serving router (docs/SERVING.md 'Paged KV + replica tier').
+
+One engine replica saturates at its slot/block pool; the "millions of
+users" architecture is N replicas behind a device-free router.  This
+module is the router half of the ``serve_replicas`` tier
+(``distributed/replica_fleet.py`` owns the replica processes):
+
+* **prefix-affinity dispatch** — requests whose prompt opens with the same
+  ``serve_affinity_tokens`` tokens (the shared-system-prompt chat pattern)
+  route to the SAME replica, so that replica's radix prefix cache
+  (``infer/paged.py``) serves the shared span from blocks instead of
+  re-prefilling it N ways.  Affinity yields to load: when the sticky
+  replica carries ``serve_affinity_slack`` more in-flight requests than
+  the least-loaded one, least-loaded wins (cache locality never starves
+  the fleet).
+* **least-loaded fallback** — cold prefixes (and affinity overflow) go to
+  the replica with the fewest router-tracked in-flight requests.
+* **per-replica health/breaker** — each replica carries its own
+  ``serving_guard.CircuitBreaker`` (PR 3's breaker, generalized from
+  per-process to per-replica): connection failures and 5xx answers count
+  as failures, an OPEN replica is skipped by dispatch, a half-open one
+  admits its single probe request, and a failed forward retries ONCE on a
+  different healthy replica before answering the client.  All replicas
+  open => 503 + Retry-After from the router without a forward.
+* **chief-merged observability** — ``/health`` aggregates per-replica
+  health; ``/metrics`` serves the router's own series plus every
+  replica's scraped exposition RELABELED with ``replica="<i>"`` (HELP/
+  TYPE lines deduped), so one scrape sees per-replica slot occupancy,
+  block-pool gauges, and prefix hit rates next to the router's dispatch
+  counters.
+
+The router is deliberately DEVICE-FREE (stdlib + telemetry only — no jax
+import): it runs in the parent process next to the replica fleet and its
+dispatch logic is unit-testable with fake transports
+(tests/router_test.py).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import re
+import threading
+import time
+import typing
+import urllib.error
+import urllib.request
+
+from .. import telemetry
+from .serving_guard import CircuitBreaker, HTTPStatusError
+
+#: endpoints the router forwards verbatim to a replica
+FORWARD_PATHS = ("/completion", "/token_completion", "/encode", "/decode")
+#: affinity-keyed (prompt-carrying) paths
+COMPLETION_PATHS = ("/completion", "/token_completion")
+
+
+class Replica:
+    """Router-side view of one replica: address, breaker, in-flight count."""
+
+    def __init__(self, index: int, port: int, host: str = "127.0.0.1",
+                 breaker_threshold: int = 3, breaker_cooldown_s: float = 5.0,
+                 clock: typing.Callable[[], float] = time.monotonic):
+        self.index = int(index)
+        self.host = host
+        self.port = int(port)
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown_s,
+                                      clock)
+        self.inflight = 0
+        self.requests = 0
+        self.failures = 0
+        self._lock = threading.Lock()
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def begin(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.requests += 1
+
+    def done(self) -> None:
+        with self._lock:
+            self.inflight = max(0, self.inflight - 1)
+
+
+def _http_transport(replica: Replica, path: str, body: dict,
+                    timeout: float) -> typing.Tuple[int, dict]:
+    """Default transport: POST the body to the replica, return
+    ``(status, payload)``.  Connection-level failures raise (the router
+    counts them as replica failures and retries elsewhere)."""
+    req = urllib.request.Request(
+        replica.base_url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except Exception:
+            payload = {"error": str(e), "code": "server_error"}
+        return e.code, payload
+
+
+def _scrape_text(replica: Replica, path: str, timeout: float) -> str:
+    with urllib.request.urlopen(replica.base_url + path,
+                                timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def relabel_exposition(text: str, replica: int,
+                       seen_meta: typing.Optional[set] = None
+                       ) -> typing.List[str]:
+    """Insert ``replica="<i>"`` into every sample line of a Prometheus
+    text exposition; ``# HELP``/``# TYPE`` lines pass through once across
+    replicas (``seen_meta`` dedupes).  Malformed lines are dropped rather
+    than corrupting the merged scrape."""
+    out: typing.List[str] = []
+    seen_meta = seen_meta if seen_meta is not None else set()
+    sample = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})? "
+                        r"([-+0-9.eE]+|NaN|[-+]?Inf)$")
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if line not in seen_meta:
+                seen_meta.add(line)
+                out.append(line)
+            continue
+        m = sample.match(line)
+        if m is None:
+            continue
+        name, labels, value = m.group(1), m.group(2), m.group(3)
+        inner = labels[1:-1] if labels else ""
+        inner = f'replica="{replica}"' + ("," + inner if inner else "")
+        out.append(f"{name}{{{inner}}} {value}")
+    return out
+
+
+class Router:
+    """Dispatch policy + forwarding.  ``transport(replica, path, body,
+    timeout)`` is injectable (tests drive the state machine with fakes)."""
+
+    def __init__(self, replicas: typing.Sequence[Replica],
+                 affinity_tokens: int = 32, affinity_slack: int = 4,
+                 forward_timeout_s: float = 150.0,
+                 transport: typing.Callable = _http_transport,
+                 clock: typing.Callable[[], float] = time.monotonic):
+        self.replicas = list(replicas)
+        self.affinity_tokens = int(affinity_tokens)
+        self.affinity_slack = int(affinity_slack)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.transport = transport
+        self.clock = clock
+        #: prefix key -> replica index, LRU-capped
+        self._affinity: "collections.OrderedDict[tuple, int]" = \
+            collections.OrderedDict()
+        self._affinity_cap = 4096
+        self._lock = threading.Lock()
+        r = telemetry.registry()
+        self._m_requests = r.counter(
+            "hbnlp_router_requests_total",
+            "requests the router forwarded, by replica and outcome",
+            ("replica", "outcome"))
+        self._m_affinity = r.counter(
+            "hbnlp_router_affinity_total",
+            "prefix-affinity routing decisions", ("result",))
+        self._m_inflight = r.gauge(
+            "hbnlp_router_replica_inflight",
+            "router-tracked in-flight requests per replica", ("replica",))
+        self._m_breaker = r.gauge(
+            "hbnlp_router_replica_breaker",
+            "per-replica breaker state: 0=closed 1=half_open 2=open",
+            ("replica",))
+
+    # -- policy --------------------------------------------------------------
+
+    def _prefix_key(self, path: str, body: dict) -> typing.Optional[tuple]:
+        if self.affinity_tokens <= 0 or path not in COMPLETION_PATHS:
+            return None
+        if path == "/token_completion":
+            toks = body.get("tokens") or []
+            if not isinstance(toks, (list, tuple)) or not toks:
+                return None
+            return ("t",) + tuple(toks[:self.affinity_tokens])
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            return None
+        # ~4 bytes/token for byte-level vocabularies; the key only needs to
+        # be STABLE per shared system prompt, not token-exact
+        return ("p", prompt[:self.affinity_tokens * 4])
+
+    def _usable(self) -> typing.List[Replica]:
+        """Replicas dispatch may target: closed or half-open breakers
+        (half-open's next forward is its probe)."""
+        return [r for r in self.replicas if r.breaker.tick() != "open"]
+
+    def pick(self, path: str, body: dict) -> Replica:
+        """Choose a replica, or raise 503 when every breaker is open."""
+        usable = self._usable()
+        if not usable:
+            retry = min(r.breaker.retry_after() for r in self.replicas)
+            raise HTTPStatusError(
+                503, {"error": "all replicas unavailable (breakers open)",
+                      "code": "unavailable"}, retry_after=max(1.0, retry))
+        least = min(usable, key=lambda r: (r.inflight, r.index))
+        key = self._prefix_key(path, body)
+        if key is None:
+            return least
+        with self._lock:
+            sticky = self._affinity.get(key)
+            if sticky is not None:
+                self._affinity.move_to_end(key)
+        if sticky is not None:
+            target = self.replicas[sticky]
+            if (target.breaker.tick() != "open"
+                    and target.inflight <= least.inflight
+                    + self.affinity_slack):
+                self._m_affinity.labels(result="hit").inc()
+                return target
+            # sticky replica open or overloaded: fall through to
+            # least-loaded and re-learn the prefix there
+        self._m_affinity.labels(result="miss").inc()
+        with self._lock:
+            self._affinity[key] = least.index
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
+        return least
+
+    # -- forwarding ----------------------------------------------------------
+
+    def forward(self, path: str, body: dict) -> dict:
+        """Pick + transport with one cross-replica retry.  5xx answers and
+        connection failures count into the source replica's breaker; 2xx
+        and 4xx (client errors) count as replica health."""
+        first = self.pick(path, body)
+        try:
+            return self._forward_one(first, path, body)
+        except HTTPStatusError as e:
+            if e.status < 500:
+                raise
+            retry_on = [r for r in self._usable() if r is not first]
+            if not retry_on:
+                raise
+            second = min(retry_on, key=lambda r: (r.inflight, r.index))
+            return self._forward_one(second, path, body)
+
+    def _forward_one(self, replica: Replica, path: str, body: dict) -> dict:
+        replica.begin()
+        self._m_inflight.labels(replica=str(replica.index)).set(
+            replica.inflight)
+        try:
+            status, payload = self.transport(replica, path, body,
+                                             self.forward_timeout_s)
+        except HTTPStatusError:
+            raise
+        except Exception as e:  # connection refused / reset / timeout
+            replica.failures += 1
+            replica.breaker.record_failure()
+            self._m_requests.labels(replica=str(replica.index),
+                                    outcome="unreachable").inc()
+            raise HTTPStatusError(
+                502, {"error": f"replica {replica.index} unreachable: {e}",
+                      "code": "bad_gateway"})
+        finally:
+            replica.done()
+            self._m_inflight.labels(replica=str(replica.index)).set(
+                replica.inflight)
+            self._m_breaker.labels(replica=str(replica.index)).set(
+                {"closed": 0, "half_open": 1, "open": 2}.get(
+                    replica.breaker.state, 0))
+        if status >= 500:
+            replica.failures += 1
+            replica.breaker.record_failure()
+            self._m_requests.labels(replica=str(replica.index),
+                                    outcome="server_error").inc()
+            raise HTTPStatusError(status, payload)
+        # 2xx and 4xx both prove the replica is alive and answering
+        replica.breaker.record_success()
+        self._m_requests.labels(replica=str(replica.index),
+                                outcome="ok" if status < 400
+                                else "client_error").inc()
+        if status >= 400:
+            raise HTTPStatusError(status, payload)
+        return payload
+
+    # -- merged observability ------------------------------------------------
+
+    def health(self, probe: typing.Optional[typing.Callable] = None) -> dict:
+        """Aggregated /health: per-replica breaker + in-flight view, plus
+        each replica's own /health payload when reachable.  ``status`` is
+        "ok" only while at least one replica is dispatchable AND actually
+        answered its probe — breakers start closed, so without the
+        reachability requirement a tier whose replicas are still loading
+        their model would tell a load balancer to route traffic into
+        connection-refused 502s."""
+        probe = probe or (lambda r: _scrape_text(r, "/health", 5.0))
+        replicas = []
+        reachable = 0
+        for r in self.replicas:
+            entry = {"replica": r.index, "port": r.port,
+                     "breaker": r.breaker.tick(), "inflight": r.inflight,
+                     "requests": r.requests, "failures": r.failures}
+            try:
+                entry["health"] = json.loads(probe(r))
+                reachable += 1
+            except Exception as e:
+                entry["unreachable"] = str(e)
+            replicas.append(entry)
+        usable = bool(self._usable()) and reachable > 0
+        return {"status": "ok" if usable else "unavailable",
+                "tier": {"replicas": len(self.replicas),
+                         "reachable": reachable,
+                         "dispatchable": sum(
+                             1 for r in self.replicas
+                             if r.breaker.state != "open")},
+                "replicas": replicas}
+
+    def ready(self, probe: typing.Optional[typing.Callable] = None
+              ) -> typing.Tuple[bool, dict]:
+        """Tier readiness: at least one dispatchable replica whose OWN
+        ``/ready`` answers — the startup window (ports not yet bound)
+        reads not-ready, so a readiness-honoring LB holds traffic until a
+        replica can actually serve."""
+        probe = probe or (lambda r: _scrape_text(r, "/ready", 2.0))
+        ready = 0
+        for r in self._usable():
+            try:
+                probe(r)
+                ready += 1
+            except Exception:
+                continue
+        return ready > 0, {"ready": ready > 0, "replicas_ready": ready}
+
+    def metrics(self, scrape: typing.Optional[typing.Callable] = None
+                ) -> str:
+        """Chief-merged exposition: the router's own registry + every
+        reachable replica's scrape relabeled ``replica="<i>"``."""
+        scrape = scrape or (lambda r: _scrape_text(r, "/metrics", 10.0))
+        lines = [telemetry.prometheus_text(telemetry.snapshot()).rstrip()]
+        seen_meta: set = set()
+        for r in self.replicas:
+            try:
+                text = scrape(r)
+            except Exception:
+                continue  # a dead replica must not fail the fleet scrape
+            lines.extend(relabel_exposition(text, r.index, seen_meta))
+        return "\n".join(line for line in lines if line) + "\n"
+
+
+def serve_replicated(params, workers: int = 1,
+                     port: typing.Optional[int] = None,
+                     stop: typing.Optional[typing.Any] = None,
+                     control: typing.Optional[dict] = None):
+    """Blocking replica-tier entry point (``serve_replicas`` >= 2 in
+    web_api mode): spawn the replica fleet on ports ``port+1..port+N``,
+    serve the router on ``port``.  ``stop`` (threading.Event-alike) tears
+    the fleet down cleanly; ``control`` receives live handles for tests
+    (``router``, ``fleet``)."""
+    from ..distributed.replica_fleet import ReplicaFleet
+    from .rest_api import DEFAULT_PORT, _run_http
+
+    n = int(getattr(params, "serve_replicas", 0) or 0)
+    if n < 2:
+        raise ValueError(f"serve_replicated needs serve_replicas >= 2, "
+                         f"got {n}")
+    port = DEFAULT_PORT if port is None else int(port)
+    telemetry.register_build_info()
+    fleet = ReplicaFleet(params, n, base_port=port + 1)
+    router = Router(
+        [Replica(i, port + 1 + i,
+                 breaker_threshold=int(getattr(params,
+                                               "serve_breaker_threshold", 3)
+                                       or 3),
+                 breaker_cooldown_s=float(getattr(
+                     params, "serve_breaker_cooldown_s", 5.0)))
+         for i in range(n)],
+        affinity_tokens=int(getattr(params, "serve_affinity_tokens", 32)),
+        affinity_slack=int(getattr(params, "serve_affinity_slack", 4)),
+        forward_timeout_s=float(getattr(params, "serve_request_deadline_s",
+                                        120.0)) + 30.0)
+    if control is not None:
+        control["router"] = router
+        control["fleet"] = fleet
+
+    def dispatch(path: str, body: dict) -> dict:
+        if path == "/health":
+            payload = router.health()
+            if payload["status"] != "ok":
+                raise HTTPStatusError(503, payload)
+            return payload
+        if path == "/ready":
+            ok, payload = router.ready()
+            if not ok:
+                raise HTTPStatusError(503, payload, retry_after=1.0)
+            return payload
+        if path == "/metrics":
+            return {"_prometheus": router.metrics()}
+        return router.forward(path, body)
+
+    paths = list(FORWARD_PATHS) + ["/health", "/ready", "/metrics"]
+    # the fleet spawns NON-daemonic model-loading processes: everything
+    # from start() on runs under the finally that stops them, or a failure
+    # in the setup window would leave the interpreter joining N orphaned
+    # replicas forever at exit
+    try:
+        fleet.start()
+        server = threading.Thread(
+            target=_run_http,
+            args=(port, paths, dispatch, workers),
+            kwargs={"max_body_bytes": int(getattr(params,
+                                                  "serve_max_body_bytes",
+                                                  0) or 0)},
+            daemon=True)
+        server.start()
+        print(f"replica tier on :{port} — router + {n} replicas on "
+              f":{port + 1}..:{port + n}")
+        while stop is None or not stop.is_set():
+            fleet.poll()
+            if stop is None:
+                time.sleep(1.0)
+            else:
+                stop.wait(1.0)
+    finally:
+        fleet.stop()

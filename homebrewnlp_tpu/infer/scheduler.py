@@ -141,15 +141,25 @@ class SlotScheduler:
     # -- slots ---------------------------------------------------------------
 
     def admit(self, now: typing.Optional[float] = None,
-              limit: typing.Optional[int] = None
+              limit: typing.Optional[int] = None,
+              fits: typing.Optional[typing.Callable[[EngineRequest], bool]]
+              = None
               ) -> typing.List[typing.Tuple[int, EngineRequest, float]]:
         """Place queued requests into free slots, strictly FIFO.  Returns
-        ``(slot, request, queue_wait_seconds)`` per admission."""
+        ``(slot, request, queue_wait_seconds)`` per admission.
+
+        ``fits(req)`` (optional — the paged executor's ``can_admit``) gates
+        each admission on executor capacity beyond slot count (KV block
+        reservations): a False answer stops admission AT THE HEAD — the
+        request stays queued (exhaustion queues, never errors) and nothing
+        behind it skips ahead, preserving FIFO fairness."""
         now = self.clock() if now is None else now
         out = []
         budget = len(self._free) if limit is None else min(limit,
                                                            len(self._free))
         while self.pending and budget > 0:
+            if fits is not None and not fits(self.pending[0]):
+                break
             req = self.pending.popleft()
             slot = self._free.pop(0)
             self.resident[slot] = (req, now)
@@ -186,9 +196,11 @@ class EngineController:
 
     ``answer(req, outcome)`` is the caller's responder; ``hooks(event,
     **kw)`` (optional) receives ``admitted`` (queue_age=), ``evicted``,
-    ``recycled`` (residency=), ``chunk`` (dt=, steps=, cache_bytes=), and
-    ``first_token`` (reqs=[...]) — ``rest_api`` turns these into the
-    /metrics slot series and the TTFT/ITL histograms.
+    ``recycled`` (residency=), ``chunk`` (dt=, steps=, cache_bytes=),
+    ``first_token`` (reqs=[...]), and — paged executors only — ``pool``
+    (the ``pool_stats()`` occupancy/sharing dict) — ``rest_api`` turns
+    these into the /metrics slot + block series and the TTFT/ITL
+    histograms.
     """
 
     def __init__(self, executor, scheduler: SlotScheduler, guard=None,
@@ -267,8 +279,24 @@ class EngineController:
         limit = None
         if breaker == "half_open":
             limit = max(0, 1 - len(self.sched.resident))
-        for slot, req, waited in self.sched.admit(now, limit=limit):
-            self.executor.admit(slot, req)
+        fits = getattr(self.executor, "can_admit", None)
+        if fits is None:
+            admitted = self.sched.admit(now, limit=limit)
+            for slot, req, waited in admitted:
+                self.executor.admit(slot, req)
+        else:
+            # one admission at a time: each executor.admit RESERVES its
+            # block need, and the next head-of-queue fits check must see
+            # that reservation — a batched check would over-admit past
+            # the pool
+            admitted = []
+            while limit is None or len(admitted) < limit:
+                one = self.sched.admit(now, limit=1, fits=fits)
+                if not one:
+                    break
+                self.executor.admit(one[0][0], one[0][1])
+                admitted += one
+        for slot, req, waited in admitted:
             self._first_done[slot] = False
             self.hooks("admitted", queue_age=waited)
         if not self.sched.resident:
@@ -304,6 +332,12 @@ class EngineController:
                              - max(int(q_before[slot]), thr - 1))
         self.hooks("chunk", dt=dt, steps=advanced, generated=generated,
                    cache_bytes=getattr(self.executor, "cache_bytes", 0))
+        # paged executor: per-chunk block-pool occupancy + sharing stats
+        # flow through the same hook seam (rest_api exports the hbnlp_kv_*
+        # gauges from them; the scheduler stays engine-flavor-agnostic)
+        pool_stats = getattr(self.executor, "pool_stats", None)
+        if pool_stats is not None:
+            self.hooks("pool", **pool_stats())
         first, finished = [], []
         for slot, (req, _) in sorted(self.sched.resident.items()):
             threshold = max(1, req.prompt_len(seq))

@@ -246,6 +246,47 @@ def _row_write(state: "DecodeState", buf: jax.Array, row: jax.Array,
     return jax.lax.dynamic_update_slice_in_dim(buf, row, state.pos, axis)
 
 
+def gather_blocks(pool: jax.Array, table: jax.Array, baxis: int,
+                  sax: int) -> jax.Array:
+    """Materialise per-slot full-length cache views from a block pool.
+
+    ``pool``: a cache leaf with its slot axis replaced by a PHYSICAL-block
+    axis (size ``num_blocks``) at ``baxis`` and its sequence axis replaced
+    by a block-local axis (size ``block_tokens``) at ``sax`` (> baxis).
+    ``table``: int32 ``[slots, seq_blocks]`` mapping each slot's logical
+    block to a physical block; entries >= num_blocks are UNMAPPED and read
+    as zeros (``mode="fill"`` — the paged analogue of the slot engine's
+    zeroed rows).  Returns the view ``[..., slots, ..., seq, ...]`` the
+    decode body consumes (infer/paged.py; docs/SERVING.md 'Paged KV')."""
+    g = jnp.take(pool, table, axis=baxis, mode="fill", fill_value=0)
+    # take inserts the seq_blocks axis at baxis+1; move it next to the
+    # block-local axis (now at sax+1) and merge the two into the full
+    # sequence axis
+    g = jnp.moveaxis(g, baxis + 1, sax)
+    shape = list(g.shape)
+    merged = shape[:sax] + [shape[sax] * shape[sax + 1]] + shape[sax + 2:]
+    return g.reshape(merged)
+
+
+def scatter_blocks(pool: jax.Array, view: jax.Array, table: jax.Array,
+                   baxis: int, sax: int, block_tokens: int) -> jax.Array:
+    """Write per-slot views back into the block pool (inverse of
+    :func:`gather_blocks`).  ``table`` here is the WRITE table: entries >=
+    num_blocks DROP their blocks (read-only shared blocks are never
+    written back — the copy-on-write invariant), and a physical block id
+    appears as writable in at most one slot's row (exclusive ownership —
+    the host-side BlockPool maintains it, so scatter order never matters).
+    Under donation the scatter updates the pool in place (the paged chunk
+    step's HLO audit pins every pool leaf aliased input->output)."""
+    shape = list(view.shape)
+    nb = shape[sax] // block_tokens
+    v = view.reshape(shape[:sax] + [nb, block_tokens] + shape[sax + 1:])
+    v = jnp.moveaxis(v, sax, baxis + 1)
+    idx: typing.List[typing.Any] = [slice(None)] * pool.ndim
+    idx[baxis] = table
+    return pool.at[tuple(idx)].set(v, mode="drop")
+
+
 def _batch_leading(x: NamedTensor, batch: int) -> NamedTensor:
     """Vector-pos KV tensors need the batch dim leading (scatter_rows
     contract).  Batch-less tensors (positional key embeddings reaching the

@@ -135,6 +135,12 @@ def query_mode(params: ModelParameter, args):
 
 
 def web_api_mode(params: ModelParameter, args):
+    replicas = int(getattr(params, "serve_replicas", 0) or 0)
+    if replicas >= 2:
+        # multi-replica tier (docs/SERVING.md): the parent stays
+        # DEVICE-FREE — each replica subprocess loads the model itself —
+        # and runs the router + fleet supervisor instead of a device loop
+        return _serve_replicated_mode(params)
     params, model, variables, mesh = _load_model(params)
     interface = InterfaceWrapper(params, model, variables, mesh=mesh)
     from ..infer.rest_api import serve
@@ -167,6 +173,32 @@ def web_api_mode(params: ModelParameter, args):
         for sig, prev in previous.items():
             if prev is not None:  # None = installed by non-Python code;
                 signal.signal(sig, prev)  # signal() rejects it
+
+
+def _serve_replicated_mode(params: ModelParameter):
+    """web_api with ``serve_replicas`` >= 2: router + replica fleet, with
+    the same preemption-safe SIGTERM/SIGINT drain as single-replica
+    serving (the fleet is terminated cleanly, not orphaned)."""
+    import signal
+    import threading
+    from ..infer.router import serve_replicated
+    from .train_loop import _ShutdownFlag
+    stop = threading.Event()
+    handler = _ShutdownFlag(
+        message="draining the replica tier (repeat to force-exit)",
+        on_signal=stop.set)
+    previous = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            previous[sig] = signal.signal(sig, handler)
+        except ValueError:
+            pass
+    try:
+        serve_replicated(params, workers=params.web_workers, stop=stop)
+    finally:
+        for sig, prev in previous.items():
+            if prev is not None:
+                signal.signal(sig, prev)
 
 
 def debug_mode(params: ModelParameter, args):

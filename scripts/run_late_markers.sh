@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Late-marker test runner (docs: README 'Tests').
+#
+# Tier-1 on this box truncates at the 870 s timeout (ROADMAP 'Tier-1
+# verify'), which silently hides the marker suites that collect AFTER the
+# cutoff — they all pass standalone, but the tier-1 log never shows them.
+# This script runs each post-truncation suite standalone and prints a
+# per-suite pass/fail summary, so "tier-1 green" stops being the only
+# (incomplete) signal.
+#
+#   scripts/run_late_markers.sh                   # the full late set
+#   scripts/run_late_markers.sh serving router    # a subset
+#   LATE_MARKER_TIMEOUT=1200 scripts/run_late_markers.sh   # per-suite cap
+set -u
+cd "$(dirname "$0")/.."
+
+MARKERS=("$@")
+if [ ${#MARKERS[@]} -eq 0 ]; then
+  MARKERS=(serving contbatch distributed specdecode staticanalysis
+           attribution pagedkv router)
+fi
+PER_SUITE_TIMEOUT="${LATE_MARKER_TIMEOUT:-900}"
+
+declare -a RESULTS
+rc_all=0
+for m in "${MARKERS[@]}"; do
+  log="/tmp/late_marker_${m}.log"
+  t0=$(date +%s)
+  timeout -k 10 "$PER_SUITE_TIMEOUT" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$m" \
+    -p no:cacheprovider -p no:randomly >"$log" 2>&1
+  rc=$?
+  dt=$(( $(date +%s) - t0 ))
+  line=$(grep -aE '^[0-9]+ (passed|failed)' "$log" | tail -1)
+  [ -z "$line" ] && line=$(tail -1 "$log")
+  if [ "$rc" -eq 0 ]; then
+    status=PASS
+  elif [ "$rc" -ge 124 ] && [ "$rc" -le 137 ]; then
+    status=TIMEOUT; rc_all=1
+  else
+    status=FAIL; rc_all=1
+  fi
+  RESULTS+=("$(printf '%-7s %5ss  %-14s %s' "$status" "$dt" "$m" "$line")")
+  printf '%-7s %5ss  %-14s %s\n' "$status" "$dt" "$m" "$line"
+done
+
+echo
+echo "== late-marker summary (logs: /tmp/late_marker_<suite>.log) =="
+printf '%s\n' "${RESULTS[@]}"
+exit $rc_all

@@ -23,31 +23,50 @@ def _free_port() -> int:
 
 
 def _spawn_workers(worker: str, extra_args, env_devcount: int = 4,
-                   n_procs: int = 2, timeout: int = 420):
+                   n_procs: int = 2, timeout: int = 420, retries: int = 1):
     """Launch n multi-controller worker processes on a shared coordinator
-    port with a virtual CPU mesh; returns [(proc, output), ...]."""
-    port = _free_port()
-    env = dict(os.environ)
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   env.get("XLA_FLAGS", ""))
-    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
-               XLA_FLAGS=flags +
-               f" --xla_force_host_platform_device_count={env_devcount}")
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(port), str(pid), str(n_procs)]
-        + [str(a) for a in extra_args],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for pid in range(n_procs)]
-    results = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        results.append((p, out))
-    return results
+    port with a virtual CPU mesh; returns [(proc, output), ...].
+
+    Retries the WHOLE fleet once on any nonzero exit (the
+    ``bench_multihost.py`` guard, shared by every fleet test): a 1-core CI
+    box oversubscribed by N jax processes occasionally starves the
+    coordination-service heartbeat, which SIGABRTs the entire fleet with
+    'another task died' — scheduler starvation, not product behavior.
+    Under tier-1 contention this was the one remaining flake (every suite
+    passes standalone); correctness assertions run on the surviving
+    attempt's output."""
+    last = None
+    for attempt in range(retries + 1):
+        port = _free_port()
+        env = dict(os.environ)
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS=flags +
+                   f" --xla_force_host_platform_device_count={env_devcount}")
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid), str(n_procs)]
+            + [str(a) for a in extra_args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+            for pid in range(n_procs)]
+        results = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            results.append((p, out))
+        if all(p.returncode == 0 for p, _ in results):
+            return results
+        last = results
+        if attempt < retries:
+            print(f"fleet attempt {attempt + 1} failed "
+                  "(heartbeat starvation under load?); retrying",
+                  flush=True)
+    return last
 
 
 def two_process_assembly_test():

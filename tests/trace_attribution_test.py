@@ -239,7 +239,8 @@ def ledger_inflated_negative_control_test():
     assert set(stored["entry_points"]) == {"train_step", "decode_chunk_step",
                                            "prefill_entry_step", "eval_fn",
                                            "engine_chunk_step",
-                                           "spec_chunk_step"}
+                                           "spec_chunk_step",
+                                           "paged_chunk_step"}
     clean = cost_ledger.ledger_audit(current=copy.deepcopy(stored))
     assert clean == []
     bad = copy.deepcopy(stored)
