@@ -502,6 +502,48 @@ class ModelParameter:
         # checkpoint commit protocol: a peer that died mid-save surfaces as
         # a named timeout here instead of hanging the pod forever
         self.distributed_barrier_timeout_s = 600.0
+        # ---- elastic pod training (docs/DISTRIBUTED.md 'Elasticity') ----
+        # each process maintains a heartbeat lease in the coordination-
+        # service KV (distributed/elastic.py): a peer whose lease lapses
+        # (SIGKILLed host, wedged rank) is detected in ~elastic_lease_
+        # timeout_s and every survivor exits MEMBERSHIP_EXIT_CODE (144) so
+        # the elastic controller (scripts/run_manager.py --elastic) can
+        # re-form the pod at the surviving world size from the freshest
+        # complete checkpoint — no human, no fixed --num-processes.  Off =
+        # the rigid fleet (a dead rank hangs peers until jax's own
+        # heartbeat timeout, and relaunch needs the full original world
+        # size)
+        self.elastic_training = False
+        # seconds between lease heartbeats (KV writes on the coordinator's
+        # gRPC channel — no device collectives, safe during jitted steps)
+        self.elastic_lease_interval_s = 1.0
+        # a peer lease older than this = membership change.  Must
+        # comfortably exceed the interval; GC pauses and storage stalls
+        # shorter than this never false-positive
+        self.elastic_lease_timeout_s = 10.0
+        # after detecting a lapse the agent gives the main thread this long
+        # to exit through the loop's own membership check (between steps)
+        # before force-exiting the process — the main thread may be wedged
+        # in a collective against the dead rank and can never finish
+        self.elastic_exit_grace_s = 3.0
+        # ---- gradient all-reduce policy (docs/DISTRIBUTED.md) ----
+        # "fused" = the historical GSPMD lowering (per-leaf all-reduces at
+        # the compiler's discretion; bit-identical to every earlier round).
+        # "bucketed" = the train step computes per-data-shard gradients
+        # under a partial-manual shard_map and issues ONE multi-operand
+        # all-reduce per size-targeted bucket of grad leaves, in reverse-
+        # topological order (output-side leaves first — the ones whose
+        # backward contributions complete first), so the collectives can
+        # overlap the remaining backward compute.  Losses match fused
+        # within float reduction-order tolerance (mean-of-shard-means vs
+        # global mean); configs the policy cannot carry (pipeline/sequence
+        # meshes, pcgrad/mgda, grad accumulation, video) fall back to
+        # fused with a loud warning
+        self.grad_allreduce = "fused"
+        # bucket size target in MiB: smaller = more, earlier collectives
+        # (better overlap, more per-op latency); larger = fewer, bigger
+        # ones.  A single leaf above the target gets its own bucket
+        self.grad_bucket_mb = 4.0
 
         self.unknown_config_keys: typing.List[str] = []
         for k, v in config.items():
@@ -546,6 +588,27 @@ class ModelParameter:
             raise ValueError("distributed_barrier_timeout_s must be > 0 "
                              "(it bounds the async-save commit rendezvous), "
                              f"got {self.distributed_barrier_timeout_s}")
+        if self.elastic_lease_interval_s <= 0:
+            raise ValueError("elastic_lease_interval_s must be > 0, got "
+                             f"{self.elastic_lease_interval_s}")
+        if self.elastic_lease_timeout_s <= self.elastic_lease_interval_s:
+            # a timeout at/below the heartbeat cadence would declare every
+            # peer dead between two of its own beats
+            raise ValueError("elastic_lease_timeout_s must exceed "
+                             "elastic_lease_interval_s, got "
+                             f"{self.elastic_lease_timeout_s} <= "
+                             f"{self.elastic_lease_interval_s}")
+        if self.elastic_exit_grace_s < 0:
+            raise ValueError("elastic_exit_grace_s must be >= 0, got "
+                             f"{self.elastic_exit_grace_s}")
+        # tri-state-style gate like serve_engine: a typo would silently
+        # train through the wrong collective schedule
+        if self.grad_allreduce not in ("fused", "bucketed"):
+            raise ValueError("grad_allreduce must be \"fused\" or "
+                             f"\"bucketed\", got {self.grad_allreduce!r}")
+        if self.grad_bucket_mb <= 0:
+            raise ValueError("grad_bucket_mb must be > 0, got "
+                             f"{self.grad_bucket_mb}")
         if self.serve_request_deadline_s <= 0:
             raise ValueError("serve_request_deadline_s must be > 0 (it is "
                              "the default deadline, not just a cap), got "

@@ -22,6 +22,7 @@ the cross-host telemetry merge both depend on that property.
 from __future__ import annotations
 
 import os
+import time
 import typing
 
 #: explicit-flag env vars for the CPU multiprocess rig (docs/DISTRIBUTED.md)
@@ -148,12 +149,28 @@ def coordination_client():
 
 def barrier(name: str, timeout_s: float = 600.0) -> None:
     """Block until every process reaches ``barrier(name)``; no-op
-    single-process.  Raises on timeout — a peer that died mid-protocol
-    surfaces here instead of hanging the caller forever."""
+    single-process.  Raises ``TimeoutError`` naming the barrier on
+    timeout/peer-death — a peer that died mid-protocol surfaces as a
+    NAMED error at the caller (which protocol step, how long) instead of
+    hanging forever or raising an anonymous gRPC status
+    (tests/distributed_test.py::kv_barrier_edge_cases_test)."""
     client = coordination_client()
     if client is None:
         return
-    client.wait_at_barrier(name, int(timeout_s * 1000))
+    t0 = time.monotonic()
+    try:
+        client.wait_at_barrier(name, int(timeout_s * 1000))
+    except Exception as e:
+        # one error type for every barrier failure (callers handle
+        # timeout and peer-death identically: the pod is broken), but the
+        # message reports the MEASURED wait — an instant gRPC failure
+        # (dead coordinator, bad barrier id) must not masquerade as a
+        # full timeout_s wait on a wedged peer
+        elapsed = time.monotonic() - t0
+        raise TimeoutError(
+            f"coordination barrier {name!r} failed after {elapsed:.1f}s "
+            f"(timeout {timeout_s}s; peer dead or wedged "
+            f"mid-protocol?): {e}") from e
 
 
 def kv_put(key: str, value: str) -> bool:

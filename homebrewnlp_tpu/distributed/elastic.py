@@ -1,0 +1,378 @@
+"""Elastic pod membership: KV heartbeat leases + shrink/grow support
+(docs/DISTRIBUTED.md 'Elasticity', ROADMAP item 5).
+
+PR 10 proved the two hard primitives — resume across a host-count change
+is multiset-exact, and preemption relaunch is pod-wide — but the fleet
+stayed rigid: a dead host needed a human and a fixed ``--num-processes``.
+This module is the missing membership layer:
+
+* **Worker side** (``ElasticAgent``, started by the train loop when
+  ``elastic_training`` is on): a daemon thread publishes a heartbeat lease
+  under a generation-numbered key in the coordination-service KV
+  (``bootstrap.kv_put`` — gRPC to the coordinator, NO device collectives,
+  so it keeps beating while the main thread runs jitted steps) and scans
+  its peers' leases.  A peer whose lease stops advancing for
+  ``elastic_lease_timeout_s`` (SIGKILLed host, wedged rank) — or a dead
+  coordinator — is a MEMBERSHIP EVENT: the agent records it, writes a
+  marker file naming the lapsed ranks, gives the main loop a short grace
+  to exit through its own check (between steps), then force-exits the
+  process with ``MEMBERSHIP_EXIT_CODE``.  Force-exit is deliberate: the
+  main thread may already be wedged in a collective against the dead rank
+  and can never finish; the freshest COMPLETE checkpoint on disk is the
+  recovery point (an uncommitted async save stays invisible to
+  ``restore_latest_valid`` — PR 10's torn-save semantics).
+
+* **Chief mirror**: process 0's agent mirrors the lease table to
+  ``<model_path>/elastic/leases.json`` through the fs seam, so the
+  elastic controller (``scripts/run_manager.py --elastic``) — which is
+  not a member of the jax cluster and cannot read the coordination KV —
+  observes membership through the same shared storage the checkpoints
+  ride.
+
+* **Controller helpers** (no jax imports): marker/mirror readers and the
+  exit-code classifier the controller uses to decide shrink vs crash.
+
+Generation numbers: every fleet (re)launch is a new generation
+(``HBNLP_GENERATION``, fresh coordinator port, fresh
+``jax.distributed.initialize`` at the new world size).  Lease keys embed
+the generation so a stale publisher from a dying generation can never
+satisfy the next one's liveness scan.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import typing
+
+#: a survivor of a membership change exits with this code — resumable
+#: from the freshest complete checkpoint at the surviving world size.
+#: Distinct from 143 (graceful preemption, emergency checkpoint written):
+#: a membership exit could NOT write a checkpoint (the pod lost a rank
+#: mid-step), so the controller resumes from the last committed one.
+#: The controller (scripts/run_manager.py --elastic) imports this module's
+#: helpers directly — its top level is jax-free by design.
+MEMBERSHIP_EXIT_CODE = 144
+
+#: coordination-KV namespace for leases: ``hbnlp/elastic/g<gen>/p<pid>``
+LEASE_PREFIX = "hbnlp/elastic/"
+
+
+def generation() -> int:
+    """This process's fleet generation (``HBNLP_GENERATION``, stamped by
+    the elastic controller; 0 standalone)."""
+    try:
+        return int(os.environ.get("HBNLP_GENERATION", "0"))
+    except ValueError:
+        return 0
+
+
+def elastic_dir(model_path: str) -> str:
+    from ..utils import fs
+    return fs.join(model_path, "elastic")
+
+
+def lease_mirror_path(model_path: str) -> str:
+    from ..utils import fs
+    return fs.join(elastic_dir(model_path), "leases.json")
+
+
+def membership_marker_path(model_path: str, gen: int) -> str:
+    from ..utils import fs
+    return fs.join(elastic_dir(model_path), f"membership_g{gen}.json")
+
+
+def preempt_notice_path(model_path: str) -> str:
+    """Cloud tooling (or an operator) announces an upcoming capacity loss
+    by writing ``{"processes": [ranks]}`` here; the controller shrinks
+    PROACTIVELY through the graceful 143 path (emergency checkpoint, no
+    lost steps) instead of waiting for the lease to lapse."""
+    from ..utils import fs
+    return fs.join(elastic_dir(model_path), "preempt.json")
+
+
+class ElasticAgent:
+    """Per-process heartbeat lease + peer liveness scan.
+
+    ``kv_put``/``kv_dir_get``/``clock``/``exit_fn`` are injectable so the
+    state machine unit-tests without a jax cluster
+    (tests/elastic_test.py)."""
+
+    def __init__(self, model_path: str, process_index: int,
+                 process_count: int, gen: typing.Optional[int] = None,
+                 interval_s: float = 1.0, timeout_s: float = 10.0,
+                 exit_grace_s: float = 3.0,
+                 kv_put: typing.Optional[typing.Callable] = None,
+                 kv_dir_get: typing.Optional[typing.Callable] = None,
+                 clock: typing.Callable[[], float] = time.monotonic,
+                 exit_fn: typing.Callable[[int], None] = os._exit,
+                 on_event: typing.Optional[typing.Callable[[str], None]] = None,
+                 pre_exit: typing.Optional[typing.Callable[[], None]] = None):
+        from . import bootstrap
+        self.model_path = model_path
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.gen = generation() if gen is None else int(gen)
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.exit_grace_s = float(exit_grace_s)
+        self._kv_put = kv_put or bootstrap.kv_put
+        self._kv_dir_get = kv_dir_get or bootstrap.kv_dir_get
+        self._clock = clock
+        self._exit = exit_fn
+        self._on_event = on_event
+        self._pre_exit = pre_exit
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: typing.Optional[threading.Thread] = None
+        #: peer -> (last seen seq, clock() when it last ADVANCED)
+        self._peer_beats: typing.Dict[int, typing.Tuple[int, float]] = {}
+        self._started_at: typing.Optional[float] = None
+        self._kv_fail_since: typing.Optional[float] = None
+        self.event: typing.Optional[str] = None  # human-readable cause
+        self.lapsed: typing.List[int] = []
+
+    # -- lease lifecycle ----------------------------------------------------
+
+    def _key(self, pid: int) -> str:
+        return f"{LEASE_PREFIX}g{self.gen}/p{pid}"
+
+    def start(self) -> "ElasticAgent":
+        self._started_at = self._clock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="elastic-lease")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s * 2 + 1)
+
+    def membership_event(self) -> typing.Optional[str]:
+        """Non-None once a membership change was detected — the train
+        loop's between-steps check (the clean exit path; the agent's
+        force-exit is the backstop for a wedged main thread)."""
+        return self.event
+
+    # -- the heartbeat thread ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # never kill the lease on a scan bug
+                print(f"WARNING: elastic lease tick failed: {e}", flush=True)
+            if self.event is not None:
+                self._trigger_exit()
+                return
+            self._stop.wait(self.interval_s)
+
+    def tick(self) -> typing.Optional[str]:
+        """One heartbeat + liveness scan (public for the unit tests)."""
+        now = self._clock()
+        self._seq += 1
+        ok = self._kv_put(self._key(self.process_index), json.dumps(
+            {"seq": self._seq, "ospid": os.getpid()}))
+        if not ok:
+            # the KV store lives on the coordinator (process 0): repeated
+            # publish failure = the coordinator itself is gone, which is a
+            # membership event for everyone else
+            if self._kv_fail_since is None:
+                self._kv_fail_since = now
+            elif now - self._kv_fail_since > self.timeout_s:
+                self._record_event("coordination service unreachable for "
+                                   f"{now - self._kv_fail_since:.1f}s "
+                                   "(coordinator lost?)", lapsed=[0])
+                return self.event
+        else:
+            self._kv_fail_since = None
+        table = dict(self._scan(now))
+        if self.process_index == 0:
+            self._mirror(table, now)
+        lapsed = [pid for pid, age in table.items()
+                  if age is not None and age > self.timeout_s]
+        # a peer that NEVER published only counts once the generation had
+        # time to come up: processes start the agent at different times
+        # (compile skew), so missing keys age against the agent's own start
+        started = self._started_at if self._started_at is not None else now
+        missing = [pid for pid, age in table.items() if age is None
+                   and now - started > self.timeout_s]
+        if lapsed or missing:
+            self._record_event(
+                "peer lease(s) lapsed: "
+                + ", ".join(f"p{p}" for p in sorted(lapsed + missing)),
+                lapsed=sorted(lapsed + missing))
+        return self.event
+
+    def _scan(self, now: float) -> typing.Iterator[
+            typing.Tuple[int, typing.Optional[float]]]:
+        """(peer, seconds since its lease last ADVANCED | None if never
+        seen).  Ages are measured on the LOCAL monotonic clock from the
+        moment the beat count changed — no cross-host clock comparison."""
+        seen: typing.Dict[int, int] = {}
+        for key, value in self._kv_dir_get(f"{LEASE_PREFIX}g{self.gen}/"):
+            name = key.rsplit("/", 1)[-1]
+            if not name.startswith("p"):
+                continue
+            try:
+                seen[int(name[1:])] = int(json.loads(value)["seq"])
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+        for pid in range(self.process_count):
+            if pid == self.process_index:
+                continue
+            if pid not in seen:
+                yield pid, (None if pid not in self._peer_beats
+                            else now - self._peer_beats[pid][1])
+                continue
+            seq = seen[pid]
+            last = self._peer_beats.get(pid)
+            if last is None or seq != last[0]:
+                self._peer_beats[pid] = (seq, now)
+                yield pid, 0.0
+            else:
+                yield pid, now - last[1]
+
+    def _record_event(self, cause: str, lapsed: typing.List[int]) -> None:
+        if self.event is not None:
+            return
+        self.event = cause
+        self.lapsed = lapsed
+        print(f"ELASTIC: membership change detected (generation "
+              f"{self.gen}): {cause}; exiting "
+              f"{MEMBERSHIP_EXIT_CODE} for the elastic controller",
+              flush=True)
+        try:
+            self._write_marker()
+        except Exception as e:
+            print(f"WARNING: membership marker write failed: {e}",
+                  flush=True)
+        if self._on_event is not None:
+            try:
+                self._on_event(cause)
+            except Exception:
+                pass
+
+    def _trigger_exit(self) -> None:
+        """Grace for the main loop's own check, then force-exit: the main
+        thread may be wedged in a collective against the dead rank."""
+        deadline = self._clock() + self.exit_grace_s
+        while self._clock() < deadline:
+            if self._stop.is_set():
+                return  # the loop noticed and is exiting cleanly
+            time.sleep(0.05)
+        if self._pre_exit is not None:
+            # last-chance host-side accounting (the chief's DataLog flush)
+            # before os._exit skips every finally: the callback must be
+            # device-free and idempotent against the main thread's own
+            # cleanup (train_loop guards it with a once-lock)
+            try:
+                self._pre_exit()
+            except Exception as e:
+                print(f"WARNING: elastic pre-exit hook failed: {e}",
+                      flush=True)
+        self._exit(MEMBERSHIP_EXIT_CODE)
+
+    # -- shared-storage mirror / marker --------------------------------------
+
+    def _mirror(self, table: typing.Dict[int, typing.Optional[float]],
+                now: float) -> None:
+        from ..utils import fs
+        fs.makedirs(elastic_dir(self.model_path))
+        payload = {
+            "generation": self.gen,
+            "world_size": self.process_count,
+            "leases": {str(self.process_index): {"age_s": 0.0,
+                                                 "seq": self._seq},
+                       **{str(pid): {"age_s": age} for pid, age
+                          in table.items() if age is not None}},
+        }
+        with fs.open_(lease_mirror_path(self.model_path), "w") as f:
+            json.dump(payload, f)
+
+    def _write_marker(self) -> None:
+        from ..utils import fs
+        fs.makedirs(elastic_dir(self.model_path))
+        with fs.open_(membership_marker_path(self.model_path, self.gen),
+                      "w") as f:
+            json.dump({"generation": self.gen, "lapsed": self.lapsed,
+                       "cause": self.event,
+                       "reporter": self.process_index}, f)
+
+
+# ---- controller side (no jax; scripts/run_manager.py imports lazily) -------
+
+_CKPT_NAME = re.compile(r"^ckpt_(\d+)$")
+
+
+def latest_complete_step(model_path: str) -> int:
+    """Newest COMMITTED checkpoint step under ``model_path`` (-1 none).
+    Directory-name scan only — commit is an atomic rename from
+    ``ckpt_<step>.tmp``, so a listed ``ckpt_<step>`` is complete (torn
+    saves keep the ``.tmp`` suffix and never match).  jax-free through the
+    fs seam: the elastic controller polls this to pick grow boundaries."""
+    from ..utils import fs
+    try:
+        names = fs.listdir(model_path)
+    except (OSError, FileNotFoundError):
+        return -1
+    steps = [int(m.group(1)) for m in map(_CKPT_NAME.match, names) if m]
+    return max(steps, default=-1)
+
+
+def read_membership_marker(model_path: str, gen: int) -> typing.Optional[dict]:
+    path = os.path.join(model_path, "elastic", f"membership_g{gen}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def read_preempt_notice(model_path: str) -> typing.Optional[dict]:
+    path = os.path.join(model_path, "elastic", "preempt.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def clear_preempt_notice(model_path: str) -> None:
+    try:
+        os.remove(os.path.join(model_path, "elastic", "preempt.json"))
+    except OSError:
+        pass
+
+
+def classify_exit(rc: typing.Optional[int]) -> str:
+    """Controller-side exit classification:
+
+    * ``killed``     — SIGKILL'd from outside (capacity loss; 137 is the
+                       shell spelling of -9)
+    * ``membership`` — a survivor that self-exited on a lapsed peer lease
+    * ``collateral`` — jax's own runtime noticed the dead rank first
+                       (SIGABRT "another task died" / SIGSEGV teardown)
+    * ``preempted``  — graceful 143 (emergency checkpoint written)
+    * ``ok`` / ``running`` / ``crash``
+    """
+    if rc is None:
+        return "running"
+    if rc == 0:
+        return "ok"
+    if rc == 143:
+        return "preempted"
+    if rc == MEMBERSHIP_EXIT_CODE:
+        return "membership"
+    if rc in (137, -9):
+        return "killed"
+    if rc in (134, -6, 139, -11, -15):
+        # SIGABRT "another task died" / SIGSEGV teardown / a drain-TERM
+        # that found the rank wedged in a dead collective (the graceful
+        # handler never gets a step boundary to act on, so the default
+        # disposition kills it: -15)
+        return "collateral"
+    return "crash"

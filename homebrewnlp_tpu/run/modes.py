@@ -14,7 +14,7 @@ from ..core import sharding as shardlib
 from ..infer.interface import InterfaceWrapper, Tokenizer, debug_similarity, query_repl
 from ..model import Model
 from ..train import checkpoint as ckpt
-from .train_loop import PREEMPTED_EXIT_CODE
+from .train_loop import MEMBERSHIP_EXIT_CODE, PREEMPTED_EXIT_CODE
 from .train_loop import train as train_loop
 
 
@@ -84,6 +84,11 @@ def _load_model(params: ModelParameter, batch_size: int = 1):
 def train_mode(params: ModelParameter, args):
     result = train_loop(params)
     print(result)
+    if result.get("membership_change"):
+        # pod membership changed (a peer's lease lapsed): no emergency
+        # checkpoint was possible — the elastic controller re-forms the
+        # fleet at the surviving world size from the freshest complete one
+        return MEMBERSHIP_EXIT_CODE
     if result.get("preempted"):
         # distinct exit code: the emergency checkpoint is written and the
         # run is resumable — scripts/run_manager.py relaunches on this code

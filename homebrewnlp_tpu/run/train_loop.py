@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import sys
 import time
 import typing
 
@@ -37,6 +38,13 @@ from .analysis import analyze_model
 #: same; scripts/run_manager.py recognises it and relaunches instead of
 #: declaring the run finished (keep the two constants in sync).
 PREEMPTED_EXIT_CODE = 143
+
+#: exit code of a run that stopped because pod MEMBERSHIP changed (a peer's
+#: lease lapsed): unlike 143 no emergency checkpoint is possible (the pod
+#: lost a rank mid-step, distributed-save barriers would hang on it), so
+#: the elastic controller resumes the surviving hosts from the freshest
+#: COMPLETE checkpoint.  One definition, in the elastic module.
+from ..distributed.elastic import MEMBERSHIP_EXIT_CODE  # noqa: E402
 
 
 class NonFiniteLossError(RuntimeError):
@@ -344,6 +352,53 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 f.write(trainer.lowered(state, first_batch).as_text())
             print(f"save_graph: lowered train step written to {path}")
 
+    # ---- elastic membership (docs/DISTRIBUTED.md 'Elasticity'): a daemon
+    # thread heartbeats a lease in the coordination KV and scans its peers;
+    # a lapsed peer makes every survivor exit MEMBERSHIP_EXIT_CODE so the
+    # elastic controller re-forms the pod at the surviving world size.  The
+    # chief's pre-exit hook flushes the DataLog consumption count even on
+    # the force-exit path (os._exit skips every finally), keeping the
+    # data-stream resume multiset-exact across the membership change.
+    elastic_agent = None
+    datalog_flush = None
+    consumed_ref = [0]
+    if is_chief and not params.use_random_dataloader:
+        import threading as _threading
+        _flush_lock = _threading.Lock()
+        _flushed = [False]
+
+        def datalog_flush(final: bool = False):
+            """Rewrite the run-log entry with the sub-batches actually
+            consumed — the ONE copy both the plain finally path and (when
+            elastic) the agent's force-exit hook route through.
+            Once-locked: a force-exit racing the finally must not tear
+            the log mid-rewrite; the FIRST writer wins."""
+            with _flush_lock:
+                if _flushed[0] and not final:
+                    return
+                _flushed[0] = True
+                log = read_runs_log(params)
+                if log:
+                    log[-1]["steps"] = consumed_ref[0]
+                    with fs.open_(fs.join(params.model_path,
+                                          "DataLog.log"), "w") as f:
+                        for entry in log:
+                            f.write(json.dumps(entry) + "\n")
+
+    if params.elastic_training and jax.process_count() > 1:
+        from ..distributed.elastic import ElasticAgent
+
+        elastic_agent = ElasticAgent(
+            params.model_path, jax.process_index(), jax.process_count(),
+            interval_s=params.elastic_lease_interval_s,
+            timeout_s=params.elastic_lease_timeout_s,
+            exit_grace_s=params.elastic_exit_grace_s,
+            pre_exit=datalog_flush).start()
+        print(f"elastic: lease agent started (generation "
+              f"{elastic_agent.gen}, world size {jax.process_count()}, "
+              f"interval {params.elastic_lease_interval_s}s, timeout "
+              f"{params.elastic_lease_timeout_s}s)", flush=True)
+
     eval_batches = None
     if params.eval_interval:
         if params.use_video:
@@ -368,6 +423,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     tel_jsonl_last = [0.0]
     tel_publish = tel_gather = None
     tel_mfu = tel_tokens = None
+    tel_membership = None
     mfu_flops_per_step = 0.0
     mfu_peak_total = 1.0
     if params.telemetry_enabled:
@@ -390,6 +446,25 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         tel_preempt = reg.counter(
             "hbnlp_train_preemptions_total",
             "graceful SIGTERM/SIGINT stops (emergency checkpoint written)")
+        if elastic_agent is not None:
+            # elastic observability (docs/DISTRIBUTED.md 'Elasticity'):
+            # which generation this process believes it is in, at what
+            # world size, and how many membership exits it has taken —
+            # the controller-side run.log and these series must agree
+            reg.gauge(
+                "hbnlp_elastic_generation",
+                "fleet generation this process launched under "
+                "(HBNLP_GENERATION, stamped by the elastic controller)"
+            ).set(elastic_agent.gen)
+            reg.gauge(
+                "hbnlp_elastic_world_size",
+                "process count of this generation's jax cluster"
+            ).set(jax.process_count())
+            tel_membership = reg.counter(
+                "hbnlp_elastic_membership_exits_total",
+                "membership-change exits (peer lease lapse or coordinator "
+                "loss; resumed by the elastic controller from the freshest "
+                "complete checkpoint)")
         # live MFU (docs/OBSERVABILITY.md 'Cost attribution'): analytical
         # forward FLOPs traced ONCE here (abstract — no device work), the
         # per-step gauge is ledger-FLOPs / measured step time / peak.
@@ -492,6 +567,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         prev_handlers = {}
     nonfinite_streak = 0
     stopped = False
+    membership = False
     nproc = jax.process_count()
     broadcast_ok = [True]
     # pods agree on the stop at this iteration cadence: a blocking broadcast
@@ -551,6 +627,16 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # step would serialise dispatch against compute)
         step_now = start_step
         while step_now < total_steps:
+            if elastic_agent is not None and \
+                    elastic_agent.membership_event() is not None:
+                # the clean half of the membership exit: the agent detected
+                # a lapsed peer while this thread was BETWEEN steps.  No
+                # emergency checkpoint (its barriers would hang on the dead
+                # rank); the freshest complete checkpoint is the recovery
+                # point.  A thread wedged IN a step never reaches here —
+                # the agent's grace-then-force-exit covers that path.
+                membership = True
+                break
             if profile_steps is not None:
                 if not profiling and step_now >= profile_steps[0]:
                     jax.profiler.start_trace(os.path.join(params.model_path,
@@ -585,6 +671,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     tel_mfu.set(mfu_flops_per_step / (t2 - t0)
                                 / mfu_peak_total)
             consumed += params.macro_batching
+            consumed_ref[0] = consumed
             if params.nonfinite_loss_tolerance > 0:
                 # the jitted step already SKIPPED the update on-device for a
                 # non-finite loss (train/__init__.py select); here the host
@@ -683,6 +770,28 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # path exists to write
         try:
             try:
+                if elastic_agent is not None and not membership \
+                        and sys.exc_info()[0] is None:
+                    # normal completion / graceful 143: stop the lease
+                    # thread BEFORE the final flushes — peers exiting at
+                    # their own pace would otherwise look like lapses and
+                    # force-exit this process mid-emergency-save.  On the
+                    # membership path the agent stays ALIVE on purpose: its
+                    # grace-then-force-exit is the watchdog for a finally
+                    # that wedges on the dead rank.  Ditto on an EXCEPTION
+                    # unwind: a step that raises under elasticity is most
+                    # often the collective noticing a dead peer BEFORE this
+                    # rank's lease scan does ("Connection closed by peer"
+                    # lands within ms, the lapse only after timeout_s) — the
+                    # agent must keep publishing this rank's lease so a
+                    # survivor that merely crashed on the dead rank's closed
+                    # sockets is not counted as a SECOND lost host, and its
+                    # force-exit turns a teardown wedge into a clean 144.  A
+                    # genuinely local crash observes no event, and the
+                    # daemon thread dies with the process.
+                    elastic_agent.stop()
+                if membership and tel_membership is not None:
+                    tel_membership.inc()
                 if profile_steps is not None and profiling:
                     jax.profiler.stop_trace()
                 if profiler_od is not None:
@@ -695,7 +804,7 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     # preemption whenever the save hung or raised (and
                     # close() below never ran when save raised at all)
                     logger.flush()
-                if params.use_checkpointing:
+                if params.use_checkpointing and not membership:
                     # emergency save participates in the async saver's
                     # commit barrier: submit, then FLUSH the in-flight
                     # background save(s) before this process exits — a
@@ -703,7 +812,10 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     # distributed checkpoint (docs/DISTRIBUTED.md).  A
                     # held failure from an EARLIER cadence save is logged
                     # and cleared first: it must not abort the one
-                    # checkpoint this path exists to write
+                    # checkpoint this path exists to write.  A MEMBERSHIP
+                    # exit skips all of it: the save barriers would hang
+                    # on the dead rank, and the freshest complete
+                    # checkpoint is the agreed recovery point.
                     if saver is not None:
                         old_err = saver.take_error()
                         if old_err is not None:
@@ -713,18 +825,15 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     save_state(int(state.step))
                     if saver is not None:
                         saver.close()
-                # rewrite the run log entry with the steps actually consumed
-                log = read_runs_log(params) \
-                    if is_chief and not params.use_random_dataloader else None
-                if log:
-                    log[-1]["steps"] = consumed
-                    with fs.open_(fs.join(params.model_path, "DataLog.log"), "w") as f:
-                        for entry in log:
-                            f.write(json.dumps(entry) + "\n")
+                # rewrite the run log entry with the steps actually
+                # consumed (the once-locked flusher; when elastic, the
+                # agent's force-exit hook shares it)
+                if datalog_flush is not None:
+                    datalog_flush(final=True)
             finally:
                 # runs even when the emergency save raises — the metrics
                 # files must never be the casualty of a storage failure
-                if saver is not None:
+                if saver is not None and not membership:
                     try:
                         # idempotent: a second close after the happy-path
                         # one above is a no-op; after a raise mid-finally
@@ -767,9 +876,15 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         print(f"preempted at step {int(state.step)}: emergency checkpoint "
               f"written; exit {PREEMPTED_EXIT_CODE} resumes from here",
               flush=True)
+    if membership:
+        print(f"membership change at step {step_now}: "
+              f"{elastic_agent.event}; exit {MEMBERSHIP_EXIT_CODE} — the "
+              "elastic controller resumes the survivors from the freshest "
+              "complete checkpoint", flush=True)
     return {"steps": steps_done, "wall_s": wall,
             "final_step": int(state.step),
             "preempted": stopped,
+            "membership_change": elastic_agent.event if membership else None,
             "tokens_per_sec": steps_done * params.train_batch_size
             * params.sequence_length / max(wall, 1e-9),
             **{f"final_{k}": v for k, v in last_metrics.items()}}

@@ -15,6 +15,7 @@ Replaces the reference's TF1 session loop + TPUEstimator machinery
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import typing
 
@@ -29,6 +30,35 @@ from ..optim import Optimizer
 from ..optim.gradients import MULTI_LOSS_GRADIENTS
 
 Params = typing.Dict[str, jax.Array]
+
+
+@contextlib.contextmanager
+def _local_batch_dims(p: ModelParameter, local: int):
+    """Rebind the config's batch-sized dims to one data shard's slice for
+    the duration of a trace (the bucketed policy's manual region traces the
+    model on a per-shard batch; ``Dim`` is frozen, so the shape LISTS that
+    embed the batch dim are rebuilt).  Text-only — the policy's
+    eligibility gate excludes video configs, whose frame shapes also carry
+    the batch dim."""
+    from ..core.dims import Dim
+
+    saved = (p.train_batch_size, p.batch_dim, p.macro_batch_dim,
+             p.token_dim_shape, p.input_pipeline_shape)
+    bd = Dim("batch", local)
+    p.train_batch_size = local
+    p.batch_dim = bd
+    p.macro_batch_dim = Dim("batch", local * p.macro_batching)
+    p.token_dim_shape = [bd if d.name == "batch" else d
+                         for d in p.token_dim_shape]
+    p.input_pipeline_shape = {
+        k: [bd if getattr(d, "name", None) == "batch" else d for d in v]
+        if isinstance(v, list) else v
+        for k, v in p.input_pipeline_shape.items()}
+    try:
+        yield
+    finally:
+        (p.train_batch_size, p.batch_dim, p.macro_batch_dim,
+         p.token_dim_shape, p.input_pipeline_shape) = saved
 
 
 def _info_metrics(info) -> typing.Dict[str, jax.Array]:
@@ -73,6 +103,8 @@ class Trainer:
         self._stats_fn = None
         self._eval_fn = None
         self._rng_counter = 0
+        # resolved lazily on the first traced step (warns once on fallback)
+        self._grad_allreduce_resolved: typing.Optional[str] = None
 
     # -- state -------------------------------------------------------------
     def init_state(self, batch: typing.Dict[str, jax.Array],
@@ -117,18 +149,222 @@ class Trainer:
             return "train_quantized_matmuls"
         return None
 
-    def _grads(self, variables: Params, batch, rng):
-        p = self.params
+    # -- gradient all-reduce policy (docs/DISTRIBUTED.md) -------------------
+    _INHERIT = object()
 
-        if (self.mesh is not None
-                and self.mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1
+    def grad_allreduce_fallback(self) -> typing.Optional[str]:
+        """Why ``grad_allreduce="bucketed"`` cannot run for this config
+        (None = it can).  Mirrors ``_1f1b_exclusion``: the policy refuses
+        loudly instead of silently changing the program."""
+        p = self.params
+        if p.grad_allreduce != "bucketed":
+            return None
+        if self.mesh is None:
+            return "single-device run (no data axis to reduce over)"
+        if self.mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1:
+            return "pipeline mesh (the schedules build their own grads)"
+        if self.mesh.shape.get(shardlib.SEQUENCE_AXIS, 1) > 1:
+            # ring attention is itself a shard_map over 'sequence'; nesting
+            # it inside the data-manual wrapper is unsupported
+            return "sequence-parallel mesh (nested shard_map)"
+        if p.multi_loss_strategy in ("pcgrad", "mgda"):
+            return f"multi_loss_strategy={p.multi_loss_strategy!r}"
+        if p.grad_accumulation > 1:
+            return "grad_accumulation > 1 (reduce-after-accumulate only)"
+        if p.use_video or not p.use_language:
+            return "non-text (video) model"
+        if p.memory_reduction_strategy != "none":
+            # the strategy custom_vjp backwards (and the plain native-scan
+            # "save" replay) hard-abort XLA's SPMD partitioner inside a
+            # partial-manual region on jax 0.4.37 (`Check failed:
+            # sharding.IsManualSubgroup()` — a C++ CHECK, not catchable);
+            # the jax.checkpoint-wrapped save_dots replay partitions fine.
+            # Gate on the RESOLVED policy so the abort can never be reached
+            from ..model.remat import resolve_remat
+            if resolve_remat(p, self.mesh) != "save_dots":
+                return (f"memory_reduction_strategy="
+                        f"{p.memory_reduction_strategy!r} without "
+                        "remat_policy=\"save_dots\" (strategy backwards "
+                        "abort XLA's partial-manual partitioner on this "
+                        "jax; save_dots runs the identical recurrence and "
+                        "partitions cleanly)")
+        return None
+
+    def _bucket_plan(self, variables: Params
+                     ) -> typing.List[typing.List[str]]:
+        """Size-targeted buckets over the grad pytree in REVERSE creation
+        order (parameters are created input→output, so reversed ≈ the
+        order their backward contributions complete — output-side leaves
+        first).  Each bucket's raveled leaves concatenate into ONE
+        all-reduce buffer, so buckets are dtype-homogeneous (a cast just to
+        share a collective would change the reduction numerics); a leaf
+        above the target gets its own bucket."""
+        target = max(1, int(self.params.grad_bucket_mb * (1 << 20)))
+        mesh_shape = dict(self.mesh.shape) if self.mesh is not None else {}
+
+        def concat_ok(name: str) -> bool:
+            # only leaves REPLICATED over the auto (model) axes may share a
+            # flat buffer: raveling a model-sharded leaf into a concat
+            # forces GSPMD to reshard it (measured: all-to-alls + permutes
+            # appear next to the bucket), which costs more than the
+            # per-leaf launch the bucket was saving
+            dims = self.model.param_dims.get(name, ())
+            spec = shardlib.spec_for_dims(self.params, dims, self.mesh) \
+                if self.mesh is not None else ()
+            return not any(ax is not None and ax != shardlib.DATA_AXIS
+                           and mesh_shape.get(ax, 1) > 1 for ax in spec)
+
+        buckets: typing.List[typing.List[str]] = []
+        cur: typing.List[str] = []
+        size = 0
+        cur_dtype = None
+        for name in reversed(list(variables)):
+            v = variables[name]
+            dt = np.dtype(v.dtype)
+            nb = int(np.prod(np.shape(v))) * dt.itemsize
+            if not concat_ok(name):
+                if cur:
+                    buckets.append(cur)
+                    cur, size = [], 0
+                buckets.append([name])  # its own per-leaf collective
+                continue
+            if cur and (size + nb > target or dt != cur_dtype):
+                buckets.append(cur)
+                cur, size = [], 0
+            cur.append(name)
+            size += nb
+            cur_dtype = dt
+        if cur:
+            buckets.append(cur)
+        return buckets
+
+    def _resolve_grad_allreduce(self) -> str:
+        """Resolve the policy once, warning loudly on a fallback.  Called
+        from ``_grads_with_policy`` AND eagerly from ``_build_step``: the
+        accumulation/pipeline paths never reach the policy seam, so
+        without the eager call their fallback would be silent."""
+        if self._grad_allreduce_resolved is None:
+            reason = self.grad_allreduce_fallback()
+            if self.params.grad_allreduce == "bucketed" and reason:
+                import warnings
+                warnings.warn(
+                    f"grad_allreduce='bucketed' requested but {reason} is "
+                    "not supported by the bucketed policy; falling back to "
+                    "the fused GSPMD lowering", stacklevel=3)
+            self._grad_allreduce_resolved = \
+                "fused" if (self.params.grad_allreduce != "bucketed"
+                            or reason) else "bucketed"
+        return self._grad_allreduce_resolved
+
+    def _grads_with_policy(self, variables: Params, batch, rng):
+        """``(grads, base_metrics)`` through the resolved grad_allreduce
+        policy — the ONE seam ``_micro_step`` consumes, so fused stays
+        bit-identical to every earlier round and bucketed swaps in the
+        explicit per-bucket reduction."""
+        if self._resolve_grad_allreduce() == "bucketed":
+            return self._grads_bucketed(variables, batch, rng)
+        grads, info = self._grads(variables, batch, rng)
+        return grads, _info_metrics(info)
+
+    def _grads_bucketed(self, variables: Params, batch, rng):
+        """Per-data-shard backward + explicit per-bucket gradient
+        all-reduce (``grad_allreduce="bucketed"``).
+
+        A partial-manual shard_map (manual over 'data', GSPMD-auto over
+        the model axes) computes each shard's gradients from its LOCAL
+        mean loss, then issues one multi-operand ``lax.psum`` per bucket
+        in reverse-topological order — XLA sees n_buckets independent
+        all-reduces whose operands are ready as soon as that bucket's
+        backward slice completes, instead of one per-leaf pattern fused at
+        the compiler's whim, so the collectives can overlap the remaining
+        backward compute.  mean-of-shard-means == the global mean exactly
+        in real arithmetic (equal shard sizes); floats differ only in
+        reduction order (documented tolerance, tests/elastic_test.py)."""
+        from ..parallel import compat
+        from jax.sharding import PartitionSpec as P
+
+        p = self.params
+        mesh = self.mesh
+        nshard = mesh.shape[shardlib.DATA_AXIS]
+        buckets = self._bucket_plan(variables)
+        # every non-data axis of size 1 ⇒ the model interior needs no mesh
+        # at all; keeping it would only leave 'data'-mentioning layout
+        # rules to trip over inside the manual region
+        inner_mesh = self.mesh if any(
+            v > 1 for k, v in mesh.shape.items()
+            if k != shardlib.DATA_AXIS) else None
+
+        def local(vs, b, shard_rng):
+            shard_rng = shard_rng[0]  # [1, 2] manual slice -> this shard's key
+            # inside the manual region the model sees ONE shard's batch:
+            # the config's batch-sized dims rebind to the local slice and
+            # layout rules that map dims onto 'data' must not reach
+            # with_sharding_constraint (the axis is manual here).  Trace-
+            # time mutation, restored in finally — the established
+            # eval-fn idiom (p.train)
+            saved_layout = p.layout
+            saved_mesh = self.mesh
+            p.layout = {k: v for k, v in p.layout.items() if v != "data"}
+            self.mesh = inner_mesh
+            try:
+                with _local_batch_dims(p, p.train_batch_size // nshard):
+                    grads, info = self._grads(vs, b, shard_rng,
+                                              mesh=inner_mesh)
+                    metrics = _info_metrics(info)
+            finally:
+                p.layout = saved_layout
+                self.mesh = saved_mesh
+            out: typing.Dict[str, jax.Array] = {}
+            for bucket in buckets:
+                if len(bucket) == 1:
+                    k = bucket[0]
+                    out[k] = jax.lax.psum(grads[k],
+                                          shardlib.DATA_AXIS) / nshard
+                    continue
+                # one flat buffer per bucket = ONE all-reduce launch for
+                # the whole group (the DDP bucketing move); split/reshape
+                # back is free data movement next to the collective
+                flat = jnp.concatenate([grads[k].ravel() for k in bucket])
+                red = jax.lax.psum(flat, shardlib.DATA_AXIS) / nshard
+                off = 0
+                for k in bucket:
+                    n = int(np.prod(grads[k].shape))
+                    out[k] = jax.lax.dynamic_slice_in_dim(
+                        red, off, n).reshape(grads[k].shape)
+                    off += n
+            # metrics reduce as one scalar bundle (mean of shard means)
+            names = sorted(metrics)
+            packed = jax.lax.psum(
+                jnp.stack([metrics[k].astype(jnp.float32) for k in names]),
+                shardlib.DATA_AXIS) / nshard
+            metrics = {k: packed[i] for i, k in enumerate(names)}
+            return {k: out[k] for k in grads}, metrics
+
+        fn = compat.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(shardlib.DATA_AXIS), P(shardlib.DATA_AXIS)),
+            out_specs=(P(), P()),
+            axis_names={shardlib.DATA_AXIS}, check_vma=False)
+        # one INDEPENDENT dropout stream per shard, carved outside the
+        # manual region (jax 0.4.37 cannot lower axis_index under
+        # partial-manual shard_map — the PartitionId gap)
+        shard_rngs = jax.random.split(rng, nshard)
+        return fn(variables, batch, shard_rngs)
+
+    def _grads(self, variables: Params, batch, rng, mesh=_INHERIT):
+        p = self.params
+        if mesh is Trainer._INHERIT:
+            mesh = self.mesh
+
+        if (mesh is not None
+                and mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1
                 and p.pipeline_schedule == "1f1b"):
             reason = self._1f1b_exclusion()
             if reason is None:
                 # fused forward+backward schedule (loss head inside the last
                 # stage); computes grads itself rather than via jax.grad
                 return self.model.train_grads_1f1b(variables, batch, rng,
-                                                   self.mesh)
+                                                   mesh)
             # config asked for 1f1b but an excluded feature forces GPipe —
             # say so loudly instead of silently changing the schedule
             import warnings
@@ -148,7 +384,7 @@ class Trainer:
                     v, self.model.param_dims,
                     getattr(self.model, "param_fan_in", {}),
                     p.calculation_dtype)
-            info = self.model.apply(v, batch, rng, mesh=self.mesh)
+            info = self.model.apply(v, batch, rng, mesh=mesh)
             return (info.total_loss.data if idx is None
                     else info.loss_list[idx].data), info
 
@@ -162,7 +398,7 @@ class Trainer:
         # so a thin mesh-bearing context keeps forward and replay routing
         # identical
         from ..core import scope as scope_mod
-        grad_ctx = scope_mod.Context("apply", mesh=self.mesh)
+        grad_ctx = scope_mod.Context("apply", mesh=mesh)
         grad_ctx.matmul_accumulation = p.matmul_accumulation
 
         if p.multi_loss_strategy in ("pcgrad", "mgda"):
@@ -189,7 +425,7 @@ class Trainer:
     def _micro_step(self, carry, batch_rng):
         batch, rng = batch_rng
         variables, opt_state, step = carry
-        grads, info = self._grads(variables, batch, rng)
+        grads, base_metrics = self._grads_with_policy(variables, batch, rng)
         # named-scope region: the update's ops attribute to "optimizer" in
         # HLO metadata / traces instead of blending into the model scopes
         # (docs/OBSERVABILITY.md 'Cost attribution')
@@ -198,7 +434,7 @@ class Trainer:
                                                           opt_state, step)
         metrics = {
             **_grad_norm_metrics(grads, self.params.debug_gradients),
-            **_info_metrics(info),
+            **base_metrics,
             "learning_rate": lr.astype(jnp.float32),
         }
         return (new_vars, new_opt, step + 1), metrics
@@ -231,6 +467,7 @@ class Trainer:
     # -- the jitted step ---------------------------------------------------
     def _build_step(self, donate: bool = True):
         p = self.params
+        self._resolve_grad_allreduce()
 
         def step_fn(state: TrainState, batch, rng):
             carry = (state.variables, state.opt_state, state.step)

@@ -96,7 +96,62 @@ def _free_port() -> int:
 
 # ---- worker ----------------------------------------------------------------
 
-def worker(strategy: str, steps: int, batch_per_slice: int) -> int:
+def collectives_worker(steps: int, sizes_mb: typing.List[float]) -> int:
+    """Collectives-only microbenchmark: timed cross-process all-reduces of
+    gradient-sized buffers with NO model step, so the scaling curve
+    separates gloo/TCP collective cost from core oversubscription (the
+    caveat previously folded into one efficiency number).  Each process
+    contributes a distinct full-size buffer — a replicated psum would let
+    XLA lower a local multiply instead of real communication."""
+    from homebrewnlp_tpu.distributed import bootstrap
+    bootstrap.maybe_initialize(verbose=False)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from homebrewnlp_tpu.core import sharding as shardlib
+    from homebrewnlp_tpu.parallel import compat
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices).reshape(-1), (shardlib.DATA_AXIS,))
+    nshard = len(devices)
+    rows = []
+    for size_mb in sizes_mb:
+        n = max(1, int(size_mb * (1 << 20) // 4))
+
+        def body(x):
+            return jax.lax.psum(x[0], shardlib.DATA_AXIS)
+
+        fn = jax.jit(compat.shard_map(
+            body, mesh=mesh, in_specs=P(shardlib.DATA_AXIS), out_specs=P(),
+            axis_names={shardlib.DATA_AXIS}, check_vma=False))
+        x = jax.device_put(
+            np.arange(nshard * n, dtype=np.float32).reshape(nshard, n)
+            / (nshard * n), NamedSharding(mesh, P(shardlib.DATA_AXIS)))
+        jax.block_until_ready(fn(x))  # compile + warm
+        t0 = time.monotonic()
+        for _ in range(steps):
+            out = fn(x)
+        jax.block_until_ready(out)
+        wall = time.monotonic() - t0
+        ms = wall / steps * 1e3
+        rows.append({"size_mb": size_mb, "ms_per_allreduce": round(ms, 3),
+                     # bus bytes ~ 2x buffer per ring all-reduce; report
+                     # the simple buffer-bytes/time rate for comparability
+                     "gb_per_sec": round(n * 4 / (ms / 1e3) / 1e9, 3)})
+    if pid == 0:
+        print("BENCH_MULTIHOST_RESULT " + json.dumps({
+            "kind": "collectives", "processes": nproc,
+            "devices": len(devices), "steps": steps, "rows": rows}),
+            flush=True)
+    return 0
+
+
+def worker(strategy: str, steps: int, batch_per_slice: int,
+           grad_allreduce: str = "") -> int:
     from homebrewnlp_tpu.distributed import bootstrap
     multi = bootstrap.maybe_initialize(verbose=False)
     import jax
@@ -115,6 +170,13 @@ def worker(strategy: str, steps: int, batch_per_slice: int) -> int:
     devices = jax.devices()
     ndev = len(devices)
     overrides = dict(STRATEGIES[strategy])
+    if grad_allreduce:
+        # the grad-allreduce A/B: both legs run remat_policy=save_dots (the
+        # one policy the bucketed partial-manual region supports on this
+        # jax), so the ONLY variable between fused and bucketed rows is the
+        # collective schedule
+        overrides.update(grad_allreduce=grad_allreduce,
+                         remat_policy="save_dots")
     global_batch = batch_per_slice * nproc
     params = ModelParameter(graft._config(
         sequence_length=_SEQ, train_batch_size=global_batch,
@@ -154,7 +216,7 @@ def worker(strategy: str, steps: int, batch_per_slice: int) -> int:
     wall = time.monotonic() - t0
     tokens = steps * global_batch * _SEQ
     if pid == 0:
-        print("BENCH_MULTIHOST_RESULT " + json.dumps({
+        row = {
             "strategy": strategy, "processes": nproc, "devices": ndev,
             "mesh": dict((str(k), int(v)) for k, v in mesh.shape.items()),
             "global_batch": global_batch, "sequence_length": _SEQ,
@@ -162,21 +224,26 @@ def worker(strategy: str, steps: int, batch_per_slice: int) -> int:
             "loss": round(loss, 4),
             "tokens_per_sec": round(tokens / wall, 1),
             "tokens_per_sec_per_chip": round(tokens / wall / ndev, 1),
-        }), flush=True)
+        }
+        if grad_allreduce:
+            row["grad_allreduce"] = grad_allreduce
+        print("BENCH_MULTIHOST_RESULT " + json.dumps(row), flush=True)
     return 0
 
 
 # ---- parent ----------------------------------------------------------------
 
 def _spawn_fleet(strategy: str, nproc: int, steps: int, batch_per_slice: int,
-                 timeout: int, retries: int = 1) -> typing.Optional[dict]:
+                 timeout: int, retries: int = 1,
+                 extra_args: typing.Sequence[str] = ()
+                 ) -> typing.Optional[dict]:
     """One fleet, retried once on a nonzero exit: wide fan-outs on a host
     with fewer cores than processes occasionally starve the coordination
     heartbeat (the whole fleet SIGABRTs with 'another task died'), which
     is scheduler pressure, not a property of the strategy under test."""
     for attempt in range(retries + 1):
         row = _spawn_fleet_once(strategy, nproc, steps, batch_per_slice,
-                                timeout)
+                                timeout, extra_args)
         if row is not None:
             return row
         if attempt < retries:
@@ -186,7 +253,8 @@ def _spawn_fleet(strategy: str, nproc: int, steps: int, batch_per_slice: int,
 
 
 def _spawn_fleet_once(strategy: str, nproc: int, steps: int,
-                      batch_per_slice: int, timeout: int
+                      batch_per_slice: int, timeout: int,
+                      extra_args: typing.Sequence[str] = ()
                       ) -> typing.Optional[dict]:
     port = _free_port()
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
@@ -203,7 +271,7 @@ def _spawn_fleet_once(strategy: str, nproc: int, steps: int,
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
              "--strategies", strategy, "--steps", str(steps),
-             "--batch-per-slice", str(batch_per_slice)],
+             "--batch-per-slice", str(batch_per_slice)] + list(extra_args),
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
     outs = []
@@ -277,9 +345,79 @@ def run_sweep(strategies: typing.List[str], proc_counts: typing.List[int],
     return report
 
 
+def run_collectives_sweep(proc_counts: typing.List[int], steps: int,
+                          sizes_mb: typing.List[float], timeout: int,
+                          batch_per_slice: int) -> typing.List[dict]:
+    """The collectives-only rows: all-reduce of gradient-sized buffers at
+    each process count, no model step (docs/DISTRIBUTED.md 'Measured
+    scaling')."""
+    rows = []
+    for nproc in proc_counts:
+        t0 = time.monotonic()
+        row = _spawn_fleet(
+            "dp_tp", nproc, steps, batch_per_slice, timeout,
+            extra_args=["--collectives",
+                        "--sizes-mb", ",".join(str(s) for s in sizes_mb)])
+        if row is None:
+            rows.append({"processes": nproc, "error": "no result"})
+            continue
+        summary = " ".join(
+            f"{r['size_mb']}MB={r['ms_per_allreduce']}ms" for r in row["rows"])
+        print(f"  collectives x{nproc}: {summary} "
+              f"[{time.monotonic() - t0:.0f}s]", flush=True)
+        rows.append(row)
+    return rows
+
+
+def run_grad_ab_sweep(proc_counts: typing.List[int], steps: int,
+                      batch_per_slice: int, timeout: int
+                      ) -> typing.List[dict]:
+    """The fused-vs-bucketed gradient-allreduce A/B on the dp_tp strategy
+    (the one the bucketed policy targets), both legs at
+    remat_policy=save_dots so the collective schedule is the only
+    variable."""
+    rows = []
+    for nproc in proc_counts:
+        pair: typing.Dict[str, typing.Any] = {"processes": nproc}
+        for variant in ("fused", "bucketed"):
+            t0 = time.monotonic()
+            row = _spawn_fleet("dp_tp", nproc, steps, batch_per_slice,
+                               timeout,
+                               extra_args=["--grad-allreduce", variant])
+            if row is None:
+                pair[variant] = {"error": "no result"}
+                continue
+            pair[variant] = {k: row[k] for k in
+                             ("tokens_per_sec", "tokens_per_sec_per_chip",
+                              "wall_s", "loss") if k in row}
+            print(f"  grad_ab {variant} x{nproc}: "
+                  f"{row.get('tokens_per_sec_per_chip')} tok/s/chip "
+                  f"[{time.monotonic() - t0:.0f}s incl. compile]",
+                  flush=True)
+        f = pair.get("fused", {}).get("tokens_per_sec_per_chip")
+        b = pair.get("bucketed", {}).get("tokens_per_sec_per_chip")
+        if f and b:
+            pair["bucketed_vs_fused"] = round(b / f, 3)
+        rows.append(pair)
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--collectives", action="store_true",
+                    help="(worker/sweep) collectives-only microbenchmark: "
+                         "timed all-reduces of gradient-sized buffers, no "
+                         "model step")
+    ap.add_argument("--sizes-mb", default="1,4,16", dest="sizes_mb",
+                    help="buffer sizes (MiB) for the collectives rows")
+    ap.add_argument("--grad-allreduce", default="", dest="grad_allreduce",
+                    choices=["", "fused", "bucketed"],
+                    help="(worker) run the dp_tp step under this "
+                         "grad_allreduce policy at remat_policy=save_dots")
+    ap.add_argument("--grad-ab", action="store_true", dest="grad_ab",
+                    help="run the fused-vs-bucketed grad-allreduce A/B "
+                         "sweep on dp_tp (adds the grad_allreduce_ab rows)")
     ap.add_argument("--strategies", default="dp_tp,ring_sp,moe_ep,pp_gpipe")
     ap.add_argument("--procs", default="1,2,4,8")
     ap.add_argument("--steps", type=int, default=DEFAULT_STEPS)
@@ -287,22 +425,55 @@ def main() -> int:
                     dest="batch_per_slice")
     ap.add_argument("--timeout", type=int, default=600,
                     help="seconds per (strategy, nproc) fleet")
+    ap.add_argument("--skip-strategy-sweep", action="store_true",
+                    dest="skip_strategy_sweep",
+                    help="only run the requested extra sweeps "
+                         "(--grad-ab / --collectives), merging into --out")
     ap.add_argument("--out", default=os.path.join(HERE, "..",
                                                   "MULTICHIP_MEASURED.json"))
     args = ap.parse_args()
+    sizes_mb = [float(s) for s in args.sizes_mb.split(",") if s]
     strategies = [s for s in args.strategies.split(",") if s]
     unknown = [s for s in strategies if s not in STRATEGIES]
     if unknown:
         ap.error(f"unknown strategies {unknown}; have {list(STRATEGIES)}")
     if args.worker:
-        return worker(strategies[0], args.steps, args.batch_per_slice)
+        if args.collectives:
+            return collectives_worker(args.steps, sizes_mb)
+        return worker(strategies[0], args.steps, args.batch_per_slice,
+                      grad_allreduce=args.grad_allreduce)
     proc_counts = sorted(int(p) for p in args.procs.split(","))
-    report = run_sweep(strategies, proc_counts, args.steps,
-                       args.batch_per_slice, args.timeout)
     out = os.path.abspath(args.out)
+    if args.skip_strategy_sweep:
+        # merge the extra sweeps into the existing report; a missing --out
+        # starts one from scratch rather than running the multi-hour
+        # strategy sweep the flag explicitly asked to skip
+        report = {}
+        if os.path.exists(out):
+            with open(out) as f:
+                report = json.load(f)
+    else:
+        report = run_sweep(strategies, proc_counts, args.steps,
+                           args.batch_per_slice, args.timeout)
+    if args.collectives or not args.skip_strategy_sweep:
+        report["collectives"] = run_collectives_sweep(
+            proc_counts, max(args.steps, 8), sizes_mb, args.timeout,
+            args.batch_per_slice)
+    if args.grad_ab:
+        report["grad_allreduce_ab"] = run_grad_ab_sweep(
+            [p for p in proc_counts if p > 1] or proc_counts,
+            args.steps, args.batch_per_slice, args.timeout)
     with open(out, "w") as f:
         json.dump(report, f, indent=1, sort_keys=True)
     print(f"wrote {out}")
+    # the sweep above forced the CPU rig (gloo/TCP, virtual devices): keep
+    # the silicon queue loud, like bench_decode.py --tpu-recheck does
+    print("NOTE: CPU-rig measurement — gloo/TCP collectives on an "
+          "oversubscribed host anchor the curve SHAPE, not TPU "
+          "magnitudes.  Queued on silicon (BASELINE.md 'Queued on "
+          "silicon'): the per-strategy 1-to-8-chip curve, the "
+          "fused-vs-bucketed grad-allreduce A/B (--grad-ab), and the "
+          "collectives-only rows (--collectives) on ICI.", flush=True)
     measured = [s for s, rows in report["strategies"].items()
                 if any("tokens_per_sec_per_chip" in r for r in rows)]
     skipped = [s for s, rows in report["strategies"].items()

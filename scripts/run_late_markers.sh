@@ -17,16 +17,21 @@ cd "$(dirname "$0")/.."
 MARKERS=("$@")
 if [ ${#MARKERS[@]} -eq 0 ]; then
   MARKERS=(serving contbatch distributed specdecode staticanalysis
-           attribution pagedkv router)
+           attribution pagedkv router elastic)
 fi
 PER_SUITE_TIMEOUT="${LATE_MARKER_TIMEOUT:-900}"
+# the elastic suite runs two full controller e2es (multiple jax fleet
+# generations each) — it needs more than the shared default on this box
+ELASTIC_SUITE_TIMEOUT="${LATE_MARKER_ELASTIC_TIMEOUT:-1800}"
 
 declare -a RESULTS
 rc_all=0
 for m in "${MARKERS[@]}"; do
   log="/tmp/late_marker_${m}.log"
   t0=$(date +%s)
-  timeout -k 10 "$PER_SUITE_TIMEOUT" \
+  t="$PER_SUITE_TIMEOUT"
+  [ "$m" = elastic ] && t="$ELASTIC_SUITE_TIMEOUT"
+  timeout -k 10 "$t" \
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$m" \
     -p no:cacheprovider -p no:randomly >"$log" 2>&1
   rc=$?

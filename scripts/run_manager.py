@@ -238,6 +238,14 @@ class Fleet(Manager):
     def __init__(self, args):
         super().__init__(args)
         self._pump_threads: typing.List[threading.Thread] = []
+        #: the CURRENT generation's world size — fixed here; the elastic
+        #: subclass re-derives it per generation
+        self.num_processes = args.num_processes
+
+    def fleet_env(self) -> typing.Dict[str, str]:
+        """Extra env for every worker of the next generation (the elastic
+        subclass stamps HBNLP_GENERATION here)."""
+        return {}
 
     def _pump(self, pid: int, stream):
         """Per-process log prefixing: every worker line lands in the
@@ -248,7 +256,7 @@ class Fleet(Manager):
         stream.close()
 
     def launch_fleet(self) -> typing.List[subprocess.Popen]:
-        n = self.args.num_processes
+        n = self.num_processes
         port = _free_port()  # fresh per generation: no TIME_WAIT rebind race
         self.out(f"launching fleet: {n} processes, coordinator "
                  f"localhost:{port}: {self.args.run_command}")
@@ -257,7 +265,8 @@ class Fleet(Manager):
             env = dict(os.environ,
                        HBNLP_COORDINATOR=f"localhost:{port}",
                        HBNLP_NUM_PROCESSES=str(n),
-                       HBNLP_PROCESS_ID=str(pid))
+                       HBNLP_PROCESS_ID=str(pid),
+                       **self.fleet_env())
             if self.args.cpu_rig:
                 import re
                 flags = re.sub(
@@ -282,6 +291,29 @@ class Fleet(Manager):
         for p in procs:
             if p.poll() is None:
                 self.kill(p, grace=grace)
+
+    def terminate_fleet(self, procs, grace: typing.Optional[int] = None):
+        """Graceful pod-wide stop: SIGTERM EVERY worker first (the shape a
+        real preemption has — all hosts signalled within the same step
+        window, so the pod-wide stop agreement and the step-tagged
+        emergency-save barriers line up), then wait out the shared
+        checkpoint grace, then put stragglers down.  ``kill_fleet`` by
+        contrast TERMs one process at a time with a full wait between —
+        fine for tearing down a crashed generation, wrong for a rotation
+        whose survivors must checkpoint TOGETHER."""
+        if grace is None:
+            grace = getattr(self.args, "term_grace", 600)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + grace
+        while any(p.poll() is None for p in procs) \
+                and time.monotonic() < deadline:
+            time.sleep(1)
+        self.kill_fleet(procs, grace=15)
 
     def run(self):
         procs = self.launch_fleet()
@@ -334,6 +366,216 @@ class Fleet(Manager):
             procs = self.launch_fleet()
 
 
+class ElasticFleet(Fleet):
+    """Elastic controller (``--elastic``, docs/DISTRIBUTED.md 'Elasticity').
+
+    ``--num-processes`` becomes the TARGET capacity, not a fixed world
+    size: every generation is launched at whatever world size the fleet
+    can actually field, stamped with ``HBNLP_GENERATION`` and a fresh
+    coordinator port, and resumes from the freshest COMPLETE checkpoint
+    (training's restore walk).  Membership transitions, none needing a
+    human:
+
+    - **lease lapse / SIGKILL / collateral abort** — survivors self-exit
+      144 (their lease agents detected the lapse; jax's own runtime may
+      SIGABRT some first, same signal to us): tear the generation down,
+      relaunch at ``world - dead`` WITHOUT consuming the crash budget —
+      capacity loss is not a crash.  The dead count comes from the agents'
+      membership marker when one was written, else from the exit census.
+    - **preemption notice** (``<model_path>/elastic/preempt.json``,
+      ``{"processes": [ranks]}`` or ``{"count": n}``) — PROACTIVE graceful
+      shrink through the 143 path: SIGTERM the fleet (emergency checkpoint,
+      no lost steps), relaunch at the reduced size, clear the notice.
+    - **grow** — once shrunken, when capacity is back (``--capacity-cmd``
+      exits 0; empty = always, the local-rig default), at least
+      ``--grow-delay`` s have passed, and the shrunken generation has
+      COMMITTED a checkpoint of its own (proof it resumed healthily, and
+      the re-admission boundary the new member joins at): rotate
+      gracefully through the same 143 path back to the target size.
+    - plain crash (no membership signal) / stall / pod-wide 143 / clean
+      finish keep the rigid fleet's semantics at the current world size.
+    """
+
+    def __init__(self, args):
+        super().__init__(args)
+        if not args.model_path:
+            raise SystemExit("--elastic needs --model-path (membership "
+                             "markers and checkpoints live there)")
+        # jax-free controller helpers: distributed/elastic.py top level
+        # imports nothing jax-adjacent (the worker-side agent does, lazily)
+        from homebrewnlp_tpu.distributed import elastic as elastic_mod
+        self.elastic = elastic_mod
+        self.target = args.num_processes
+        self.gen = 0
+        #: checkpoint step observed when the last shrink happened; a LATER
+        #: committed step is the grow boundary.  None = never shrunk
+        self._shrink_ckpt: typing.Optional[int] = None
+        self._gen_started = time.monotonic()
+
+    def fleet_env(self) -> typing.Dict[str, str]:
+        return {"HBNLP_GENERATION": str(self.gen)}
+
+    def _next_generation(self, world: int) -> typing.List[subprocess.Popen]:
+        self.gen += 1
+        self.num_processes = world
+        self._gen_started = time.monotonic()
+        time.sleep(self.args.restart_delay)
+        return self.launch_fleet()
+
+    def _drain(self, procs: typing.List[subprocess.Popen], grace: int):
+        """Give survivors their self-exit window (lease timeout + agent
+        grace), then put the stragglers down — a rank wedged in a dead
+        collective never exits on its own."""
+        deadline = time.monotonic() + grace
+        while any(p.poll() is None for p in procs) \
+                and time.monotonic() < deadline:
+            time.sleep(1)
+        self.kill_fleet(procs, grace=15)
+
+    def _latest_step(self) -> int:
+        return self.elastic.latest_complete_step(self.args.model_path)
+
+    def _capacity_ok(self) -> bool:
+        if not self.args.capacity_cmd:
+            return True  # local rig: a killed process is always replaceable
+        return sh(self.args.capacity_cmd).returncode == 0
+
+    def _grow_ready(self) -> bool:
+        return (self.num_processes < self.target
+                and time.monotonic() - self._gen_started
+                >= self.args.grow_delay
+                and self._latest_step() > (self._shrink_ckpt
+                                           if self._shrink_ckpt is not None
+                                           else -1)
+                and self._capacity_ok())
+
+    def run(self):
+        self.out(f"elastic controller: target {self.target} processes, "
+                 f"model_path {self.args.model_path}")
+        procs = self.launch_fleet()
+        restarts = 0
+        while True:
+            time.sleep(self.args.poll_interval
+                       + random.randint(0, self.args.poll_jitter))
+            rcs = [p.poll() for p in procs]
+            classes = [self.elastic.classify_exit(rc) for rc in rcs]
+            stalled = (self.args.stall_timeout > 0
+                       and self.heartbeat_age() > self.args.stall_timeout)
+            notice = self.elastic.read_preempt_notice(self.args.model_path)
+            if all(rc is None for rc in rcs) and not stalled:
+                if notice:
+                    leaving = len(notice.get("processes", [])) \
+                        or int(notice.get("count", 0)) or 1
+                    world = self.num_processes - leaving
+                    if world < 1:
+                        self.out(f"elastic: preemption notice {notice} "
+                                 "leaves no capacity; graceful full stop")
+                        self.kill_fleet(procs)
+                        self.elastic.clear_preempt_notice(
+                            self.args.model_path)
+                        return
+                    self.out(f"elastic: preemption notice {notice}; "
+                             f"graceful shrink {self.num_processes} -> "
+                             f"{world} (emergency checkpoint via SIGTERM)")
+                    self.terminate_fleet(procs)  # 143: checkpoint + exit
+                    self.elastic.clear_preempt_notice(self.args.model_path)
+                    self._shrink_ckpt = self._latest_step()
+                    procs = self._next_generation(world)
+                elif self._grow_ready():
+                    step = self._latest_step()
+                    self.out(f"elastic: capacity back and checkpoint "
+                             f"boundary reached (step {step} > shrink-time "
+                             f"{self._shrink_ckpt}); graceful grow "
+                             f"{self.num_processes} -> {self.target}")
+                    self.terminate_fleet(procs)  # 143: checkpoint + exit
+                    self._shrink_ckpt = None
+                    procs = self._next_generation(self.target)
+                continue
+            # a membership change needs EVIDENCE of capacity loss: a rank
+            # SIGKILLed from outside, a survivor's 144 self-exit, or the
+            # agents' marker on shared storage.  Collateral exits alone
+            # (every rank SIGABRT/SEGV, no kill, no marker) are a fleet
+            # CRASH — the known single-core heartbeat-starvation flake has
+            # exactly that shape, and shrinking a healthy pod on it would
+            # bleed capacity with nothing actually dead
+            membership = (any(c in ("membership", "killed")
+                              for c in classes)
+                          or (any(c == "collateral" for c in classes)
+                              and self.elastic.read_membership_marker(
+                                  self.args.model_path, self.gen)
+                              is not None))
+            if membership:
+                # survivors are self-exiting 144; the lease window + agent
+                # grace bounds how long that takes
+                self._drain(procs, grace=self.args.elastic_drain)
+                rcs = [p.poll() for p in procs]
+                classes = [self.elastic.classify_exit(rc) for rc in rcs]
+                marker = self.elastic.read_membership_marker(
+                    self.args.model_path, self.gen)
+                if marker:
+                    # a lapsed lease names WHO the pod lost contact with,
+                    # not WHY: a survivor the gloo runtime SIGABRTed on the
+                    # dead rank's closed sockets ("another task died")
+                    # loses its lease too, but its host is fine — cross the
+                    # marker with the exit census and count only ranks that
+                    # were killed from outside as lost capacity.  If none
+                    # classify as killed (a wedged-forever rank drain had
+                    # to TERM), trust the lease verdict as-is.
+                    lapsed = {pid for pid in set(marker.get("lapsed", []))
+                              if 0 <= pid < len(classes)}
+                    dead = sum(1 for pid in lapsed
+                               if classes[pid] == "killed") \
+                        or len(lapsed)
+                else:
+                    # exit-code census fallback: only an outside SIGKILL is
+                    # lost CAPACITY — a survivor that crashed on the dead
+                    # rank's closed sockets before its lease agent fired is
+                    # collateral, not a second lost host
+                    dead = sum(1 for c in classes if c == "killed")
+                dead = max(1, dead)
+                world = self.num_processes - dead
+                self.out(f"elastic: membership change generation "
+                         f"{self.gen} (rcs={rcs}, marker={marker}): "
+                         f"{dead} rank(s) lost")
+                if world < 1:
+                    self.out("elastic: no survivors; giving up")
+                    return
+                # a notice whose capacity loss already materialized as this
+                # membership change must not shrink the pod a SECOND time
+                # after the relaunch; tooling re-announces if more is coming
+                self.elastic.clear_preempt_notice(self.args.model_path)
+                self._shrink_ckpt = self._latest_step()
+                self.out(f"elastic: resuming {world} survivor(s) from "
+                         f"checkpoint step {self._shrink_ckpt} "
+                         f"(generation {self.gen + 1}); no crash budget "
+                         "consumed")
+                procs = self._next_generation(world)
+                continue
+            if all(rc == 0 for rc in rcs):
+                self.out("fleet finished cleanly; done")
+                return
+            if any(rc == PREEMPTED_RC for rc in rcs) and not stalled:
+                if not all(rc is not None for rc in rcs):
+                    continue  # stragglers still writing their checkpoint
+                self.out(f"fleet preempted (rcs={rcs}); relaunching at "
+                         f"world size {self.num_processes}")
+                procs = self._next_generation(self.num_processes)
+                continue
+            if any(rc is None for rc in rcs) and not stalled:
+                continue  # staggered clean finish (see Fleet.run)
+            restarts += 1
+            if 0 < self.args.max_restarts < restarts:
+                self.out(f"fleet rcs={rcs} stalled={stalled}; max restarts "
+                         "exceeded; giving up")
+                self.kill_fleet(procs, grace=15)
+                return
+            self.out(f"fleet unhealthy (rcs={rcs} stalled={stalled}); "
+                     f"restarting (#{restarts}) at world size "
+                     f"{self.num_processes}")
+            self.kill_fleet(procs, grace=15 if stalled else None)
+            procs = self._next_generation(self.num_processes)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("run_command", help="training command to supervise")
@@ -367,8 +609,33 @@ def main():
     ap.add_argument("--restart-delay", type=int, default=5,
                     dest="restart_delay",
                     help="seconds between fleet teardown and relaunch")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic membership (docs/DISTRIBUTED.md "
+                         "'Elasticity'): --num-processes becomes the "
+                         "TARGET capacity; the controller shrinks to the "
+                         "survivors on a lease lapse / preemption notice "
+                         "and grows back at a checkpoint boundary — no "
+                         "human, no fixed world size.  Workers need "
+                         "elastic_training: true")
+    ap.add_argument("--grow-delay", type=int, default=60, dest="grow_delay",
+                    help="(--elastic) minimum seconds a shrunken "
+                         "generation runs before growing back")
+    ap.add_argument("--capacity-cmd", default="", dest="capacity_cmd",
+                    help="(--elastic) shell cmd probing whether target "
+                         "capacity is available (rc 0 = yes); empty = "
+                         "always (the local rig)")
+    ap.add_argument("--elastic-drain", type=int, default=60,
+                    dest="elastic_drain",
+                    help="(--elastic) seconds to let survivors self-exit "
+                         "144 after a membership change before SIGKILLing "
+                         "stragglers (cover lease timeout + agent grace)")
     args = ap.parse_args()
-    if args.num_processes > 0:
+    if args.elastic:
+        if args.num_processes <= 0:
+            ap.error("--elastic requires --num-processes (the TARGET "
+                     "capacity)")
+        ElasticFleet(args).run()
+    elif args.num_processes > 0:
         Fleet(args).run()
     else:
         Manager(args).run()

@@ -359,9 +359,55 @@ def _mode_faultsave(args: dict) -> None:
     print(f"FAULTSAVE OK p{pid}", flush=True)
 
 
+def _mode_kvedge(args: dict) -> None:
+    """Coordination-service KV/barrier edge cases the elastic membership
+    layer leans on (docs/DISTRIBUTED.md 'Elasticity'), exercised directly
+    instead of implicitly through fleet behavior:
+
+    - ``kv_dir_get`` on a prefix nobody wrote: ``[]``, not an error
+    - ``kv_put`` overwrite: last write wins (the heartbeat lease IS a
+      rewritten key)
+    - ``barrier`` timeout: raises a ``TimeoutError`` NAMING the barrier
+      (a peer dead mid-protocol must surface as which-protocol-step, not
+      a hang or an anonymous gRPC status)
+    """
+    import jax
+
+    from homebrewnlp_tpu.distributed import bootstrap
+
+    pid = jax.process_index()
+    assert bootstrap.kv_dir_get("hbnlp/kvedge_nothing/") == []
+    if pid == 0:
+        assert bootstrap.kv_put("hbnlp/kvedge/shared", "first")
+        assert bootstrap.kv_put("hbnlp/kvedge/shared", "second")
+    assert bootstrap.kv_put(f"hbnlp/kvedge/p{pid}", f"worker{pid}")
+    bootstrap.barrier("kvedge_published", 60.0)
+    table = dict(bootstrap.kv_dir_get("hbnlp/kvedge/"))
+    suffix = {k.rsplit("/", 1)[-1]: v for k, v in table.items()}
+    assert suffix.get("shared") == "second", table  # overwrite won
+    assert suffix.get("p0") == "worker0" and suffix.get("p1") == "worker1", \
+        table
+    if pid == 1:
+        # process 0 never joins this barrier: the wait must END, raising
+        # the barrier's own name — not hang until the fleet timeout
+        t0 = time.monotonic()
+        try:
+            bootstrap.barrier("kvedge_never_joined", 3.0)
+            raise AssertionError("barrier did not time out")
+        except TimeoutError as e:
+            assert "kvedge_never_joined" in str(e), e
+            assert time.monotonic() - t0 < 30, "timed out far too late"
+            print(f"worker {pid}: barrier timeout surfaced: {e}",
+                  flush=True)
+    # the client must survive a timed-out barrier (the faultsave recovery
+    # path already depends on this): one more successful rendezvous
+    bootstrap.barrier("kvedge_done", 60.0)
+    print(f"KVEDGE OK p{pid}", flush=True)
+
+
 MODES = {"lockstep": _mode_lockstep, "save": _mode_save,
          "restore": _mode_restore, "overlap": _mode_overlap,
-         "faultsave": _mode_faultsave}
+         "faultsave": _mode_faultsave, "kvedge": _mode_kvedge}
 
 
 def main() -> int:

@@ -1,0 +1,196 @@
+"""Worker for tests/elastic_test.py: the REAL train loop under the elastic
+controller, plus a restore-probe mode for the acceptance comparisons.
+
+Two invocation shapes:
+
+1. **Under the controller** (``scripts/run_manager.py --elastic`` sets the
+   ``HBNLP_*`` env including ``HBNLP_GENERATION``)::
+
+     python _elastic_train_worker.py <cfg.json> [--step-delay S]
+
+   Writes ``<model_path>/pids/g<gen>_p<rank>.pid`` (so the test can SIGKILL
+   a specific rank), prints restore/probe markers, runs ``train()``, and
+   exits with the run mode's code (0 / 143 preempted / 144 membership).
+   ``--step-delay`` stretches each step so the test has a deterministic
+   window to kill into (the model itself is deliberately tiny).
+
+2. **Probe fleet** (spawned via ``multihost_test._spawn_workers``)::
+
+     python _elastic_train_worker.py <port> <pid> <nproc> <cfg.json> \
+         --probe-only --step N
+
+   Restores checkpoint step N and prints the same probe markers — the
+   "fresh restore at this world size" reference the elastic run's resumed
+   generations are compared against.
+
+Probe markers (chief only; the probe batch is synthetic and fixed, so the
+values are comparable across runs):
+
+- ``ELASTIC_RESTORE g=<gen> world=<n> step=<s> fwd=<repr>`` — single-device
+  forward loss of the restored parameters: NO collectives, bit-identical
+  for the same checkpoint bytes no matter the world size.
+- ``ELASTIC_STEP g=<gen> world=<n> step=<s> loss=<repr>`` — one sharded
+  trainer step from the restored state on the live mesh: comparable within
+  reduction-order tolerance at the same world size.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _probe_batch(params):
+    import numpy as np
+    rng = np.random.default_rng(123)
+    x = rng.integers(0, params.vocab_size,
+                     (params.train_batch_size, params.sequence_length, 1))
+    return {"token_x": np.asarray(x, np.int32),
+            "token_y": np.asarray((x + 1) % params.vocab_size, np.int32)}
+
+
+def _fwd_loss(params, variables_host) -> float:
+    """Single-device forward on the fixed probe batch (no collectives)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from homebrewnlp_tpu.model import Model
+
+    host_batch = _probe_batch(params)
+    model = Model(params)
+    template = model.init({k: np.asarray(v) for k, v in host_batch.items()})
+    batch = {k: jnp.asarray(v) for k, v in host_batch.items()}
+    fn = jax.jit(lambda v, b: model.apply(v, b).total_loss.data)
+    host = {k: jnp.asarray(np.asarray(variables_host[k])) for k in template}
+    return float(np.asarray(jax.device_get(fn(host, batch))))
+
+
+def _probe_step_loss(params, restored) -> float:
+    """One sharded trainer step from the restored state on the live mesh
+    (every rank must call this — it is a collective)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from homebrewnlp_tpu.core import sharding as shardlib
+    from homebrewnlp_tpu.model import Model
+    from homebrewnlp_tpu.train import Trainer, TrainState
+
+    mesh = shardlib.build_mesh(params)
+    trainer = Trainer(params, Model(params), mesh=mesh)
+    slice_index, slice_count = shardlib.process_data_slice(mesh)
+    gb = params.train_batch_size
+    local = gb // slice_count
+    full = _probe_batch(params)
+    rows = slice(slice_index * local, (slice_index + 1) * local)
+    batch = {k: v[rows] for k, v in full.items()}
+    state = trainer.init_state(batch)
+    variables = {k: np.asarray(v).astype(state.variables[k].dtype)
+                 for k, v in restored[0].items()}
+    st = TrainState(shardlib.place_tree(state.variables, variables),
+                    shardlib.place_tree(state.opt_state, restored[1]),
+                    jnp.asarray(restored[2], jnp.int32))
+    _, metrics = trainer.step(st, batch, rng=jax.random.PRNGKey(999))
+    return float(np.asarray(jax.device_get(metrics["loss"])))
+
+
+def _print_probes(params, restored, gen, tag=""):
+    import jax
+    step_loss = _probe_step_loss(params, restored)
+    if jax.process_index() == 0:
+        world = jax.process_count()
+        print(f"ELASTIC_RESTORE{tag} g={gen} world={world} "
+              f"step={restored[2]} fwd={_fwd_loss(params, restored[0])!r}",
+              flush=True)
+        print(f"ELASTIC_STEP{tag} g={gen} world={world} "
+              f"step={restored[2]} loss={step_loss!r}", flush=True)
+
+
+def main() -> int:
+    args = list(sys.argv[1:])
+    probe_only = "--probe-only" in args
+    if probe_only:
+        args.remove("--probe-only")
+    step_delay = 0.0
+    if "--step-delay" in args:
+        i = args.index("--step-delay")
+        step_delay = float(args[i + 1])
+        del args[i:i + 2]
+    probe_step = None
+    if "--step" in args:
+        i = args.index("--step")
+        probe_step = int(args[i + 1])
+        del args[i:i + 2]
+
+    if len(args) == 4:  # _spawn_workers convention: port pid nproc cfg
+        port, pid, nproc, cfg_path = args
+        os.environ["HBNLP_COORDINATOR"] = f"localhost:{port}"
+        os.environ["HBNLP_NUM_PROCESSES"] = nproc
+        os.environ["HBNLP_PROCESS_ID"] = pid
+    else:  # controller convention: env already set by run_manager
+        (cfg_path,) = args
+
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    gen = int(os.environ.get("HBNLP_GENERATION", "0"))
+    rank = int(os.environ.get("HBNLP_PROCESS_ID", "0"))
+
+    if not probe_only:
+        # pidfile so the test can SIGKILL THIS rank of THIS generation
+        pid_dir = os.path.join(cfg["model_path"], "pids")
+        os.makedirs(pid_dir, exist_ok=True)
+        with open(os.path.join(pid_dir, f"g{gen}_p{rank}.pid"), "w") as f:
+            f.write(str(os.getpid()))
+
+    from homebrewnlp_tpu.distributed import bootstrap
+    assert bootstrap.maybe_initialize()
+
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.train import Trainer, checkpoint as ckpt
+
+    params = ModelParameter(dict(cfg))
+
+    if probe_only:
+        restored = ckpt.restore(cfg["model_path"], probe_step)
+        assert restored is not None and restored[2] == probe_step
+        _print_probes(params, restored, gen, tag="_FRESH")
+        return 0
+
+    # same probe pair at every elastic generation start: the test compares
+    # a resumed generation against a fresh restore of the same checkpoint
+    restored = ckpt.restore_latest_valid(cfg["model_path"], strict=False)
+    if restored is not None:
+        _print_probes(ModelParameter(dict(cfg)), restored, gen)
+
+    if step_delay:
+        # stretch each step so the test's SIGKILL lands mid-training on a
+        # box where the tiny model would otherwise finish in under a second
+        orig_step = Trainer.step
+
+        def slow_step(self, *a, **k):
+            time.sleep(step_delay)
+            return orig_step(self, *a, **k)
+
+        Trainer.step = slow_step
+
+    from homebrewnlp_tpu.run.train_loop import (MEMBERSHIP_EXIT_CODE,
+                                                PREEMPTED_EXIT_CODE, train)
+    params = ModelParameter(dict(cfg))
+    result = train(params, log_every=4)
+    import jax
+    if jax.process_index() == 0:
+        print(f"ELASTIC_DONE g={gen} world={jax.process_count()} "
+              f"final_step={result['final_step']}", flush=True)
+    if result.get("membership_change"):
+        return MEMBERSHIP_EXIT_CODE
+    if result.get("preempted"):
+        return PREEMPTED_EXIT_CODE
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,14 +27,18 @@ def _spawn_workers(worker: str, extra_args, env_devcount: int = 4,
     """Launch n multi-controller worker processes on a shared coordinator
     port with a virtual CPU mesh; returns [(proc, output), ...].
 
-    Retries the WHOLE fleet once on any nonzero exit (the
-    ``bench_multihost.py`` guard, shared by every fleet test): a 1-core CI
-    box oversubscribed by N jax processes occasionally starves the
-    coordination-service heartbeat, which SIGABRTs the entire fleet with
-    'another task died' — scheduler starvation, not product behavior.
-    Under tier-1 contention this was the one remaining flake (every suite
-    passes standalone); correctness assertions run on the surviving
-    attempt's output."""
+    This is THE shared fleet-spawning helper for every multi-process test
+    path (multihost, distributed, elastic suites): it owns the one
+    contention-flake retry, so the policy and its logging cannot drift
+    between copies.  A 1-core CI box oversubscribed by N jax processes
+    occasionally starves the coordination-service heartbeat, which SIGABRTs
+    the entire fleet with 'another task died' — scheduler starvation, not
+    product behavior.  Under tier-1 contention this was the one remaining
+    flake (every suite passes standalone); the whole fleet retries once and
+    correctness assertions run on the surviving attempt's output.  Each
+    retry logs WHY (per-worker exit codes + the first failing worker's
+    tail) so a starvation retry is distinguishable from a real regression
+    in the test log."""
     last = None
     for attempt in range(retries + 1):
         port = _free_port()
@@ -63,9 +67,12 @@ def _spawn_workers(worker: str, extra_args, env_devcount: int = 4,
             return results
         last = results
         if attempt < retries:
-            print(f"fleet attempt {attempt + 1} failed "
-                  "(heartbeat starvation under load?); retrying",
-                  flush=True)
+            rcs = [p.returncode for p, _ in results]
+            first_bad = next(out for p, out in results if p.returncode)
+            print(f"FLEET RETRY {attempt + 1}/{retries}: worker rcs={rcs} "
+                  "(single-core heartbeat starvation is the known cause; "
+                  "SIGABRT -6 = 'another task died').  First failing "
+                  f"worker tail:\n{first_bad[-600:]}", flush=True)
     return last
 
 
