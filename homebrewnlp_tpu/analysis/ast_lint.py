@@ -30,6 +30,11 @@ mesh-axis-literal  hardcoded mesh-axis name strings ("data", "model",
 config-docs     every ModelParameter knob has a docs/CONFIG.md table row
                 (absorbed from scripts/check_config_docs.py, which now
                 shims onto this rule).
+metric-docs     every ``hbnlp_*`` metric name registered via a registry
+                ``counter()``/``gauge()``/``histogram()`` call must have a
+                row in docs/OBSERVABILITY.md's catalog (mirrors the
+                config-docs rule; an undocumented series is invisible to
+                the operator reading the doc).
 ==============  ============================================================
 
 Suppression: put ``graft-lint: allow[<rule>]`` in a comment on the
@@ -323,6 +328,73 @@ def config_docs_findings(config_py: str = CONFIG_PY,
             for k in missing_knobs(config_py, config_md)]
 
 
+# ---- metric-docs rule (mirrors config-docs) ---------------------------------
+
+OBSERVABILITY_MD = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+#: registry factory method names whose first string argument is a metric
+#: name (telemetry/registry.py Registry API)
+_METRIC_METHODS = frozenset(("counter", "gauge", "histogram"))
+_METRIC_PREFIX = "hbnlp_"
+
+
+def registered_metrics(root: str = REPO,
+                       subdirs: typing.Sequence[str] = LINT_SUBDIRS
+                       ) -> typing.List[typing.Tuple[str, str, int]]:
+    """Every ``hbnlp_*`` metric registered through a literal first argument
+    of a ``counter``/``gauge``/``histogram`` call: ``(name, rel, lineno)``.
+    Names passed through variables (e.g. ``SPAN_METRIC``) are out of scope
+    — the rule polices the literal-registration idiom every layer uses."""
+    out: typing.List[typing.Tuple[str, str, int]] = []
+    for path, rel in iter_source_files(root, subdirs):
+        with open(path) as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith(_METRIC_PREFIX)
+                    and not _suppressed(lines, node.lineno, "metric-docs")):
+                out.append((node.args[0].value, rel, node.lineno))
+    return out
+
+
+def documented_metrics(md: str) -> typing.Set[str]:
+    """Every backticked ``hbnlp_*`` name in the doc — generous on purpose:
+    a name mentioned anywhere in OBSERVABILITY.md counts as documented."""
+    return set(re.findall(r"`(hbnlp_[A-Za-z0-9_]+)`", md))
+
+
+def metric_docs_findings(root: str = REPO,
+                         subdirs: typing.Sequence[str] = LINT_SUBDIRS,
+                         obs_md: str = OBSERVABILITY_MD
+                         ) -> typing.List[Finding]:
+    try:
+        with open(obs_md) as f:
+            documented = documented_metrics(f.read())
+    except OSError:
+        documented = set()
+    findings, seen = [], set()
+    for name, rel, lineno in registered_metrics(root, subdirs):
+        if name in documented or name in seen:
+            continue
+        seen.add(name)
+        findings.append(Finding(
+            "metric-docs", f"{rel}:{lineno}",
+            f"metric `{name}` has no docs/OBSERVABILITY.md catalog row "
+            f"(add `| `{name}` | <type> | <labels> | <layer> | <meaning> |`"
+            " or mark the line `graft-lint: allow[metric-docs]`)"))
+    return findings
+
+
 # ---- repo walk -------------------------------------------------------------
 
 def iter_source_files(root: str = REPO,
@@ -341,8 +413,10 @@ def iter_source_files(root: str = REPO,
 
 def lint_repo(root: str = REPO,
               subdirs: typing.Sequence[str] = LINT_SUBDIRS,
-              config_docs: bool = True) -> typing.List[Finding]:
-    """All AST rules over the repo: per-file rules + the config-docs rule."""
+              config_docs: bool = True,
+              metric_docs: bool = True) -> typing.List[Finding]:
+    """All AST rules over the repo: per-file rules + the config-docs and
+    metric-docs coverage rules."""
     findings: typing.List[Finding] = []
     for path, rel in iter_source_files(root, subdirs):
         with open(path) as f:
@@ -351,4 +425,7 @@ def lint_repo(root: str = REPO,
         findings += config_docs_findings(
             os.path.join(root, "homebrewnlp_tpu", "config.py"),
             os.path.join(root, "docs", "CONFIG.md"))
+    if metric_docs:
+        findings += metric_docs_findings(
+            root, subdirs, os.path.join(root, "docs", "OBSERVABILITY.md"))
     return findings

@@ -484,6 +484,33 @@ class ModelParameter:
         # telemetry_enabled — profiling has no per-step cost until triggered
         self.telemetry_profile_on_signal = False
         self.telemetry_profile_steps = 10
+        # flight recorder (docs/OBSERVABILITY.md 'Flight recorder'):
+        # bounded ring of typed events (step records, membership/lease
+        # transitions, breaker trips, admission/eviction decisions,
+        # checkpoint commits, collective-phase markers) recorded
+        # UNCONDITIONALLY at rare-event cadence and dumped as
+        # <model_path>/blackbox_p<rank>.jsonl on every exit path — crash
+        # unwind, exit-143 emergency save, exit-144 membership force-exit,
+        # SIGUSR2 on demand.  This is the ring capacity; 0 disables the
+        # blackbox dump (the ring still records in-memory)
+        self.telemetry_blackbox_events = 4096
+        # size cap for <model_path>/telemetry.jsonl (and any rotating
+        # telemetry file): past this many MiB the file rotates to .1/.2/...
+        # keeping telemetry_keep_files generations, so a week-long run
+        # cannot fill the disk.  0 = unbounded (the historical behavior);
+        # remote (gs://) paths stay unbounded — rotation needs rename
+        self.telemetry_max_file_mb = 64.0
+        self.telemetry_keep_files = 2
+        # ---- request tracing (docs/OBSERVABILITY.md 'Request tracing') --
+        # mint a trace id at the router (or the HTTP edge when
+        # unreplicated), propagate it header -> request tuple -> scheduler
+        # -> engine hooks, and close spans for queue-wait, admission,
+        # per-chunk prefill/decode occupancy, paged-KV block waits and
+        # spec rounds — exported per-request as Chrome-trace JSON under
+        # <model_path>/traces/ and cross-process via the blackbox events
+        # file (scripts/forensics.py --trace merges them).  Off = zero
+        # overhead and byte-identical serving
+        self.trace_requests = False
         # overlap the next batch's host->device transfer with the running
         # device step (run/train_loop.py _AsyncFeeder): the loop starts a
         # device_put / multi-host shard placement for batch N+1 right after
@@ -526,6 +553,14 @@ class ModelParameter:
         # before force-exiting the process — the main thread may be wedged
         # in a collective against the dead rank and can never finish
         self.elastic_exit_grace_s = 3.0
+        # straggler detector (docs/OBSERVABILITY.md 'Flight recorder'):
+        # the chief's lease agent reads every rank's step progress off the
+        # lease heartbeats and flags a slow-but-alive rank — one whose
+        # published step lags the fleet and whose time-since-last-advance
+        # exceeds this factor x the fleet-median step interval — BEFORE its
+        # lease lapses (a wedged main thread keeps heartbeating forever;
+        # this is the only signal that catches it).  0 = off
+        self.elastic_straggler_factor = 4.0
         # ---- gradient all-reduce policy (docs/DISTRIBUTED.md) ----
         # "fused" = the historical GSPMD lowering (per-leaf all-reduces at
         # the compiler's discretion; bit-identical to every earlier round).
@@ -577,10 +612,15 @@ class ModelParameter:
             if v < 0:
                 raise ValueError(f"{knob} must be >= 0, got {v}")
         for knob in ("telemetry_jsonl_interval_s",
-                     "telemetry_chrome_trace_events"):
+                     "telemetry_chrome_trace_events",
+                     "telemetry_blackbox_events", "telemetry_max_file_mb",
+                     "elastic_straggler_factor"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"{knob} must be >= 0 (0 = off), got "
                                  f"{getattr(self, knob)}")
+        if self.telemetry_keep_files < 1:
+            raise ValueError("telemetry_keep_files must be >= 1, got "
+                             f"{self.telemetry_keep_files}")
         if self.telemetry_profile_steps < 1:
             raise ValueError("telemetry_profile_steps must be >= 1, got "
                              f"{self.telemetry_profile_steps}")

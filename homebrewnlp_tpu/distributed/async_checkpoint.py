@@ -149,6 +149,7 @@ def write_staged(model_path: str, staged: _Staged, max_keep: int,
         # not retried (see the sync save: replace re-runs are not idempotent)
         fs.replace(tmp_dir, ckpt_dir)
         ckpt._prune(model_path, step, max_keep)
+        _record_commit(step)
         return ckpt_dir
     pid = staged.pid
     if pid == 0 and ckpt._fsop(fs.exists, tmp_dir):
@@ -184,7 +185,17 @@ def write_staged(model_path: str, staged: _Staged, max_keep: int,
         fs.replace(tmp_dir, ckpt_dir)
         ckpt._prune(model_path, step, max_keep)
     bootstrap.barrier(f"{barrier_tag}_done", barrier_timeout_s)
+    _record_commit(step)
     return ckpt_dir
+
+
+def _record_commit(step: int) -> None:
+    """Flight-recorder marker at the ACTUAL commit (the saver thread's done
+    barrier) — the synchronous path records in ``ckpt.save``; this is the
+    async twin, so both timelines carry the recovery point."""
+    from ..telemetry import events as _flight
+    _flight.record("checkpoint_commit", step=int(step), asynchronous=True)
+    _flight.maybe_flush()
 
 
 class AsyncSaveError(RuntimeError):

@@ -157,9 +157,15 @@ def barrier(name: str, timeout_s: float = 600.0) -> None:
     client = coordination_client()
     if client is None:
         return
+    from ..telemetry import events as _flight
     t0 = time.monotonic()
     try:
         client.wait_at_barrier(name, int(timeout_s * 1000))
+        # collective-phase marker (docs/OBSERVABILITY.md 'Flight
+        # recorder'): barriers are the pod's ordering points — the
+        # forensic timeline shows which protocol step each rank reached
+        _flight.record("collective", phase=name, status="ok",
+                       seconds=round(time.monotonic() - t0, 3))
     except Exception as e:
         # one error type for every barrier failure (callers handle
         # timeout and peer-death identically: the pod is broken), but the
@@ -167,6 +173,9 @@ def barrier(name: str, timeout_s: float = 600.0) -> None:
         # (dead coordinator, bad barrier id) must not masquerade as a
         # full timeout_s wait on a wedged peer
         elapsed = time.monotonic() - t0
+        _flight.record("collective", phase=name, status="failed",
+                       seconds=round(elapsed, 3), error=str(e))
+        _flight.flush(reason="barrier-failure")
         raise TimeoutError(
             f"coordination barrier {name!r} failed after {elapsed:.1f}s "
             f"(timeout {timeout_s}s; peer dead or wedged "

@@ -109,8 +109,13 @@ class ElasticAgent:
                  clock: typing.Callable[[], float] = time.monotonic,
                  exit_fn: typing.Callable[[int], None] = os._exit,
                  on_event: typing.Optional[typing.Callable[[str], None]] = None,
-                 pre_exit: typing.Optional[typing.Callable[[], None]] = None):
+                 pre_exit: typing.Optional[typing.Callable[[], None]] = None,
+                 progress: typing.Optional[typing.Callable[[], int]] = None,
+                 straggler_factor: float = 0.0,
+                 on_straggler: typing.Optional[typing.Callable] = None,
+                 recorder=None):
         from . import bootstrap
+        from ..telemetry import events as _events
         self.model_path = model_path
         self.process_index = int(process_index)
         self.process_count = int(process_count)
@@ -124,11 +129,32 @@ class ElasticAgent:
         self._exit = exit_fn
         self._on_event = on_event
         self._pre_exit = pre_exit
+        #: host-side step mirror (the train loop updates a plain ref; the
+        #: lease value publishes it so the chief's straggler detector sees
+        #: every rank's progress without device work)
+        self._progress = progress
+        self.straggler_factor = float(straggler_factor)
+        self._on_straggler = on_straggler
+        self._recorder = recorder if recorder is not None \
+            else _events.recorder()
         self._seq = 0
         self._stop = threading.Event()
         self._thread: typing.Optional[threading.Thread] = None
         #: peer -> (last seen seq, clock() when it last ADVANCED)
         self._peer_beats: typing.Dict[int, typing.Tuple[int, float]] = {}
+        #: what the last scan SAW per peer (seq) — recorded into the flight
+        #: recorder so forensics can order cross-process events causally
+        self._last_seen: typing.Dict[int, int] = {}
+        #: rank -> (step, clock() when the step last advanced) — all ranks
+        #: incl. self, fed by the lease values' step field
+        self._rank_steps: typing.Dict[int, typing.Tuple[int, float]] = {}
+        #: rank -> last observed step-advance interval (straggler median)
+        self._step_intervals: typing.Dict[int, float] = {}
+        self._straggler_flagged: typing.Set[int] = set()
+        #: rank -> clock() when first suspected (two-scan confirmation: a
+        #: momentarily-stale lease right after a fleet-wide stall clears
+        #: must not flag a healthy peer)
+        self._straggler_suspect: typing.Dict[int, float] = {}
         self._started_at: typing.Optional[float] = None
         self._kv_fail_since: typing.Optional[float] = None
         self.event: typing.Optional[str] = None  # human-readable cause
@@ -174,8 +200,18 @@ class ElasticAgent:
         """One heartbeat + liveness scan (public for the unit tests)."""
         now = self._clock()
         self._seq += 1
-        ok = self._kv_put(self._key(self.process_index), json.dumps(
-            {"seq": self._seq, "ospid": os.getpid()}))
+        lease = {"seq": self._seq, "ospid": os.getpid()}
+        if self._progress is not None:
+            try:
+                lease["step"] = int(self._progress())
+            except Exception:
+                pass
+        ok = self._kv_put(self._key(self.process_index), json.dumps(lease))
+        # the beat event is the causal ANCHOR: a peer's lease scan that saw
+        # seq N happened after this rank recorded beat N — forensics orders
+        # cross-process events through exactly these (seq, observer) pairs
+        self._recorder.record("beat", rank=self.process_index, beat=self._seq,
+                              gen=self.gen, step=lease.get("step"))
         if not ok:
             # the KV store lives on the coordinator (process 0): repeated
             # publish failure = the coordinator itself is gone, which is a
@@ -190,8 +226,19 @@ class ElasticAgent:
         else:
             self._kv_fail_since = None
         table = dict(self._scan(now))
+        if self._last_seen:
+            # which peer beat this scan OBSERVED: the forensics timeline's
+            # cross-process ordering edges (beat(p, s) happened-before any
+            # scan that saw p at seq >= s)
+            self._recorder.record(
+                "lease_scan", rank=self.process_index, gen=self.gen,
+                peers={str(p): s for p, s in self._last_seen.items()},
+                ages={str(p): round(a, 3) for p, a in table.items()
+                      if a is not None})
         if self.process_index == 0:
             self._mirror(table, now)
+            if self.straggler_factor > 0:
+                self._check_stragglers(now, table)
         lapsed = [pid for pid, age in table.items()
                   if age is not None and age > self.timeout_s]
         # a peer that NEVER published only counts once the generation had
@@ -218,9 +265,24 @@ class ElasticAgent:
             if not name.startswith("p"):
                 continue
             try:
-                seen[int(name[1:])] = int(json.loads(value)["seq"])
+                payload = json.loads(value)
+                pid_seen = int(name[1:])
+                seen[pid_seen] = int(payload["seq"])
+                step = payload.get("step")
+                if step is not None:
+                    self._note_step(pid_seen, int(step), now)
             except (ValueError, KeyError, json.JSONDecodeError):
+                # a malformed lease value (torn KV write) must not abort
+                # the WHOLE scan — liveness detection keeps running on the
+                # peers that parsed
                 continue
+        self._last_seen = dict(seen)
+        if self._progress is not None:
+            try:
+                self._note_step(self.process_index,
+                                int(self._progress()), now)
+            except Exception:
+                pass
         for pid in range(self.process_count):
             if pid == self.process_index:
                 continue
@@ -236,6 +298,69 @@ class ElasticAgent:
             else:
                 yield pid, now - last[1]
 
+    # -- straggler detection (docs/OBSERVABILITY.md 'Flight recorder') -------
+
+    def _note_step(self, rank: int, step: int, now: float) -> None:
+        last = self._rank_steps.get(rank)
+        if last is None or step > last[0]:
+            if last is not None and step > last[0]:
+                self._step_intervals[rank] = (now - last[1]) \
+                    / max(1, step - last[0])
+            self._rank_steps[rank] = (step, now)
+            self._straggler_flagged.discard(rank)
+            self._straggler_suspect.pop(rank, None)
+
+    def _check_stragglers(self, now: float,
+                          table: typing.Dict[int, typing.Optional[float]]
+                          ) -> None:
+        """Flag a slow-but-alive rank BEFORE its lease lapses: its lease
+        keeps beating (the agent thread is fine) but its published step
+        lags the fleet and has not advanced for straggler_factor x the
+        fleet-median per-step interval.  Ranks AT the fleet-max step are
+        exempt — a finished (or sync-point-blocked) fast rank plateaus at
+        the max and is waiting on the straggler, not the other way
+        around."""
+        if len(self._rank_steps) < 2 or not self._step_intervals:
+            return
+        intervals = sorted(self._step_intervals.values())
+        median = intervals[len(intervals) // 2]
+        threshold = max(self.straggler_factor * median, 2 * self.interval_s)
+        max_step = max(s for s, _ in self._rank_steps.values())
+        for rank, (step, advanced_at) in sorted(self._rank_steps.items()):
+            if step >= max_step or rank in self._straggler_flagged:
+                self._straggler_suspect.pop(rank, None)
+                continue
+            age = now - advanced_at
+            lease_age = 0.0 if rank == self.process_index \
+                else (table.get(rank) or 0.0)
+            # only a rank whose LEASE is alive is a straggler — a lapsed
+            # lease is a membership event, handled by the caller
+            if not (age > threshold and lease_age <= self.timeout_s):
+                self._straggler_suspect.pop(rank, None)
+                continue
+            # two-scan confirmation: when a fleet-wide stall clears, the
+            # fastest rank races ahead while a peer's lease value is up to
+            # one publish interval stale — a single-scan rule would flag
+            # that healthy peer.  A real straggler stays suspect across
+            # scans; the stale lease refreshes within one interval
+            first = self._straggler_suspect.setdefault(rank, now)
+            if now - first >= self.interval_s:
+                self._straggler_flagged.add(rank)
+                self._straggler_suspect.pop(rank, None)
+                print(f"ELASTIC: straggler suspected p{rank} (step {step} "
+                      f"vs fleet max {max_step}; no step advance for "
+                      f"{age:.1f}s vs median step {median:.2f}s; lease "
+                      "still beating)", flush=True)
+                self._recorder.record(
+                    "straggler", rank=rank, step=step, fleet_max=max_step,
+                    stall_s=round(age, 3), median_step_s=round(median, 4),
+                    gen=self.gen)
+                if self._on_straggler is not None:
+                    try:
+                        self._on_straggler(rank, age, median)
+                    except Exception:
+                        pass
+
     def _record_event(self, cause: str, lapsed: typing.List[int]) -> None:
         if self.event is not None:
             return
@@ -245,6 +370,11 @@ class ElasticAgent:
               f"{self.gen}): {cause}; exiting "
               f"{MEMBERSHIP_EXIT_CODE} for the elastic controller",
               flush=True)
+        # the incident record, flushed IMMEDIATELY: even a SIGKILL landing
+        # during the exit grace leaves the detection on disk
+        self._recorder.record("membership", rank=self.process_index,
+                              gen=self.gen, cause=cause, lapsed=lapsed)
+        self._recorder.flush(reason="membership")
         try:
             self._write_marker()
         except Exception as e:
@@ -265,15 +395,24 @@ class ElasticAgent:
                 return  # the loop noticed and is exiting cleanly
             time.sleep(0.05)
         if self._pre_exit is not None:
-            # last-chance host-side accounting (the chief's DataLog flush)
-            # before os._exit skips every finally: the callback must be
-            # device-free and idempotent against the main thread's own
-            # cleanup (train_loop guards it with a once-lock)
+            # last-chance host-side accounting (the chief's DataLog flush,
+            # the chrome-trace ring dump) before os._exit skips every
+            # finally: the callback must be device-free and idempotent
+            # against the main thread's own cleanup (train_loop guards it
+            # with a once-lock)
             try:
                 self._pre_exit()
             except Exception as e:
                 print(f"WARNING: elastic pre-exit hook failed: {e}",
                       flush=True)
+        # the blackbox MUST survive the force-exit: record the exit and
+        # flush here, past the pre_exit hook, so the ring carries the full
+        # incident (membership event + this exit) no matter what the hook
+        # did — os._exit skips every finally-path flush
+        self._recorder.record("exit", rank=self.process_index, gen=self.gen,
+                              code=MEMBERSHIP_EXIT_CODE, path="force",
+                              cause=self.event)
+        self._recorder.flush(reason="force-exit")
         self._exit(MEMBERSHIP_EXIT_CODE)
 
     # -- shared-storage mirror / marker --------------------------------------
@@ -282,13 +421,19 @@ class ElasticAgent:
                 now: float) -> None:
         from ..utils import fs
         fs.makedirs(elastic_dir(self.model_path))
+        leases = {str(self.process_index): {"age_s": 0.0, "seq": self._seq},
+                  **{str(pid): {"age_s": age} for pid, age
+                     in table.items() if age is not None}}
+        # per-rank step progress (from the lease heartbeats): the operator
+        # — and the straggler story — can read fleet progress off shared
+        # storage without touching any rank
+        for pid, (step, _) in self._rank_steps.items():
+            if str(pid) in leases:
+                leases[str(pid)]["step"] = step
         payload = {
             "generation": self.gen,
             "world_size": self.process_count,
-            "leases": {str(self.process_index): {"age_s": 0.0,
-                                                 "seq": self._seq},
-                       **{str(pid): {"age_s": age} for pid, age
-                          in table.items() if age is not None}},
+            "leases": leases,
         }
         with fs.open_(lease_mirror_path(self.model_path), "w") as f:
             json.dump(payload, f)

@@ -55,6 +55,16 @@ def _replica_main(cfg: dict, port: int, index: int):
 
     stop = install_replica_stop()
     params = ModelParameter(dict(cfg), serve_replicas=0)
+    if getattr(params, "trace_requests", False) and params.model_path:
+        # replica-indexed blackbox tag BEFORE serve() (which would default
+        # to "serve"): the device loop's event file becomes
+        # blackbox_r<i>.jsonl, its HTTP child blackbox_r<i>_http.jsonl —
+        # forensics then shows which replica a trace crossed
+        from ..telemetry import events as _flight
+        _flight.configure(params.model_path, f"r{index}",
+                          capacity=getattr(params,
+                                           "telemetry_blackbox_events",
+                                           4096))
     params, model, variables, mesh = _load_model(params)
     interface = InterfaceWrapper(params, model, variables, mesh=mesh)
     print(f"[replica {index}] serving on :{port}", flush=True)

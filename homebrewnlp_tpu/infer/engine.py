@@ -495,6 +495,10 @@ class EngineExecutor:
     def reset(self) -> None:
         """Drop the pool (next dispatch re-initialises it in-trace) and
         park every slot — the recovery path after a failed dispatch."""
+        # pool re-inits are incident evidence (a failed dispatch answered
+        # every resident 500): into the flight recorder, off the hot path
+        from ..telemetry import events as _flight
+        _flight.record("engine_reset", slots=int(self.slots))
         self._carry = None
         self._admit_mask[:] = False
         self.end_pos[:] = 0
@@ -734,6 +738,9 @@ class SpecEngineExecutor(EngineExecutor):
               flush=True)
         self._events.append({"kind": "disabled", "rate": rate,
                              "drafted": drafted})
+        from ..telemetry import events as _flight
+        _flight.record("spec_disabled", accept_rate=round(rate, 4),
+                       drafted=int(drafted))
         self._spec_enabled = False
         self._spec_mask[:] = False
         self._to_plain_carry()

@@ -26,12 +26,15 @@ request receives exactly one JSON answer.
 from __future__ import annotations
 
 import contextlib
+import inspect
 import json
 import time
 import typing
 import uuid
 
 from .. import telemetry
+from ..telemetry import events as flight
+from ..telemetry import tracectx
 from ..config import ModelParameter
 from .interface import InterfaceWrapper
 from .serving_guard import (HTTPStatusError, ServingGuard, child_health,
@@ -566,11 +569,33 @@ def _retry_after_header(retry_after: typing.Optional[float]
     return str(max(1, int(retry_after + 0.999)))
 
 
+def _headers_aware(dispatch) -> typing.Callable:
+    """Adapt a dispatch callable to the 3-arg ``(path, body, headers)``
+    shape: dispatchers that declare a third parameter (the HTTP child, the
+    replica router — they read the trace header) receive the request
+    headers; legacy 2-arg dispatchers (in-process serving, tests) are
+    called exactly as before."""
+    try:
+        sig = inspect.signature(dispatch)
+        takes = sum(1 for p in sig.parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)) >= 3 \
+            or any(p.kind == p.VAR_POSITIONAL
+                   for p in sig.parameters.values())
+    except (TypeError, ValueError):
+        takes = False
+    if takes:
+        return dispatch
+    return lambda path, body, headers=None: dispatch(path, body)
+
+
 def _run_http(port: int, paths: typing.List[str],
               dispatch: typing.Callable[[str, dict], dict], workers: int = 1,
               max_body_bytes: typing.Optional[int] = None):
     """Serve the endpoint set over HTTP, blocking.  ``dispatch(path, body)``
-    produces the JSON response (directly, or via IPC to the device loop).
+    produces the JSON response (directly, or via IPC to the device loop);
+    a dispatch declaring a third parameter also receives the lower-cased
+    request headers (the trace-id propagation seam).
 
     Error classification (satellite: client errors are not server faults):
     oversized/malformed bodies and ValueErrors (e.g. _parse_filters
@@ -578,6 +603,7 @@ def _run_http(port: int, paths: typing.List[str],
     ``{"error": ..., "code": "bad_request"}`` payload; HTTPStatusError
     carries its own status (429/503/504 from the guard); anything else is a
     genuine server fault and stays 500."""
+    dispatch = _headers_aware(dispatch)
     try:
         import fastapi
         import uvicorn
@@ -611,13 +637,13 @@ def _run_http(port: int, paths: typing.List[str],
                 return await call_next(request)
         from fastapi.responses import PlainTextResponse
 
-        def _run_dispatch(p, body):
+        def _run_dispatch(p, body, headers=None):
             # JSONResponse, not HTTPException: the payload must stay at the
             # TOP level ({"error", "code"}), the one contract both server
             # branches share — HTTPException would wrap it under
             # {"detail": ...}
             try:
-                out = dispatch(p, body)
+                out = dispatch(p, body, headers)
                 if isinstance(out, dict) and "_prometheus" in out:
                     # /metrics: Prometheus scrapers need text exposition,
                     # not a JSON-encoded string of it
@@ -658,13 +684,15 @@ def _run_http(port: int, paths: typing.List[str],
                         return JSONResponse(
                             {"error": "JSON object body required",
                              "code": "bad_request"}, status_code=400)
+                    hdrs = {k.lower(): v for k, v in request.headers.items()}
                     if p in GET_PATHS:
                         # probes and /metrics are sub-ms shared-state reads:
                         # answered inline, NOT via the threadpool, whose
                         # bounded tokens slow completion polls can exhaust —
                         # they must stay responsive exactly then
-                        return _run_dispatch(p, body)
-                    return await run_in_threadpool(_run_dispatch, p, body)
+                        return _run_dispatch(p, body, hdrs)
+                    return await run_in_threadpool(_run_dispatch, p, body,
+                                                   hdrs)
                 return endpoint
             app.post(path)(make_endpoint())
             if path in GET_PATHS:
@@ -758,8 +786,9 @@ def _run_http(port: int, paths: typing.List[str],
 
         def _dispatch_reply(self, body: dict):
             retry_after = None
+            hdrs = {k.lower(): v for k, v in self.headers.items()}
             try:
-                status, payload = 200, dispatch(self.path, body)
+                status, payload = 200, dispatch(self.path, body, hdrs)
             except HTTPStatusError as e:
                 status, payload, retry_after = e.status, e.payload, e.retry_after
             except _CLIENT_ERRORS as e:  # client error, not a server fault
@@ -786,6 +815,44 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
     import threading
     cfg = cfg or {}
     mono = time.monotonic
+    # flight recorder + request tracing (docs/OBSERVABILITY.md): armed only
+    # when the parent opted in (trace_requests) — the child then leaves its
+    # own blackbox behind, flushes on SIGTERM (terminate() is how the
+    # device loop tears it down, and finally never runs there), and stamps
+    # every accepted completion with the propagated/minted trace id
+    trace_on = bool(cfg.get("trace"))
+    bb = cfg.get("blackbox") or {}
+    if bb.get("model_path"):
+        import atexit as _atexit
+        import os as _os
+        import signal as _signal
+        flight.configure(bb["model_path"], bb.get("tag", "http"),
+                         capacity=bb.get("events"))
+
+        def _term(signum, frame):
+            flight.flush(reason="sigterm")
+            _os._exit(0)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _term)
+        except (ValueError, OSError):
+            pass
+        # the fastapi branch's uvicorn.run installs ITS OWN signal
+        # handlers (replacing _term) and exits gracefully on TERM — the
+        # atexit hook covers that path; the fallback server (whose
+        # serve_forever never returns) keeps the handler above
+        _atexit.register(lambda: flight.flush(reason="atexit"))
+
+        def _bg_flush():
+            # the periodic ring rewrite runs OFF the request-serving
+            # threads: a response must never wait on a few-hundred-KB
+            # file write (the latency tails tracing exists to explain)
+            while True:
+                time.sleep(2.0)
+                flight.maybe_flush(0.0)
+
+        threading.Thread(target=_bg_flush, daemon=True,
+                         name="blackbox-flush").start()
     # child-side admission telemetry (the serving_guard admission decisions
     # happen HERE, so their counters live in this process's registry; the
     # scrape handler below merges the device loop's snapshot in)
@@ -817,7 +884,7 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
             depth += int(state.get("inflight", 0) or 0)
         return depth
 
-    def dispatch(path: str, body: dict) -> dict:
+    def dispatch(path: str, body: dict, headers=None) -> dict:
         _requests_ctr.labels(path=path).inc()
         if path == "/metrics":
             # scrape target: local (admission) registry + the device loop's
@@ -858,14 +925,25 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
         deadline_s = request_deadline_s(body, cfg)
         deadline = mono() + deadline_s
         rid = uuid.uuid4().hex
+        # trace propagation (docs/OBSERVABILITY.md 'Request tracing'): the
+        # router's header rides through; an unreplicated edge MINTS the id
+        # here.  None when tracing is off — the extra tuple slot always
+        # exists so the device loop's unpacking never branches on the knob
+        trace = None
+        if trace_on and path in BATCHED_PATHS:
+            trace = tracectx.trace_id_from_headers(headers) \
+                or tracectx.new_trace_id()
+            flight.record("request", rid=rid, path=path, trace=trace)
         _adm["accepted"].inc()
         with outstanding_lock:
             outstanding[0] += 1
+        enqueue_ts = mono()
         try:
             # the 5th field is the enqueue timestamp: the device loop's
             # queue-wait histogram reads it (CLOCK_MONOTONIC is system-wide,
-            # same cross-process argument as the deadline)
-            requests.put((rid, path, body, deadline, mono()))
+            # same cross-process argument as the deadline); the 6th is the
+            # trace id (None when tracing is off)
+            requests.put((rid, path, body, deadline, enqueue_ts, trace))
             delay = 0.0
             while True:
                 # pop-with-default: ONE Manager round-trip per poll (a
@@ -885,6 +963,11 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
         finally:
             with outstanding_lock:
                 outstanding[0] -= 1
+            if trace is not None:
+                # record only — the background flusher owns the file IO,
+                # never this request's response path
+                tracectx.record_span(trace, "http/dispatch", enqueue_ts,
+                                     mono() - enqueue_ts, rid=rid)
         out = entry["r"]
         if isinstance(out, dict) and "_error" in out:
             raise HTTPStatusError(
@@ -1211,6 +1294,129 @@ def _engine_hooks_fn(interface, scheduler, executor):
     return hooks
 
 
+class _RequestTracer:
+    """Per-request span closure for the continuous engine
+    (docs/OBSERVABILITY.md 'Request tracing').  Chained IN FRONT of the
+    metrics hooks and AROUND the answer fn, it only observes: queue-wait
+    (submit → admission), paged-KV block waits, per-chunk prefill/decode
+    occupancy, and the request total — each span recorded into the flight
+    recorder (the cross-process form forensics merges) and into a
+    per-request Chrome-trace JSON under ``<model_path>/traces/``.  Tracing
+    failures warn and never fail a decode round."""
+
+    #: per-request export cap: the traces/ directory keeps the LAST this
+    #: many trace_<id>.json files (oldest pruned at export time) — the
+    #: same boundedness discipline as the blackbox ring and RotatingJsonl;
+    #: a week of traced traffic must not exhaust the model dir's inodes
+    MAX_EXPORTS = 1024
+
+    def __init__(self, model_path: str,
+                 clock: typing.Callable[[], float] = time.monotonic):
+        import collections
+        from ..utils import fs
+        self.dir = fs.join(model_path, "traces") if model_path else None
+        self.clock = clock
+        #: rid -> {"trace", "req", "spans", "block_wait_t0"}
+        self._live: typing.Dict[str, dict] = {}
+        self._exported: typing.Deque[str] = collections.deque()
+
+    def begin(self, reqs: typing.Sequence) -> None:
+        for req in reqs:
+            if getattr(req, "trace", None):
+                self._live[req.rid] = {
+                    "trace": req.trace, "req": req,
+                    "spans": tracectx.RequestTrace(req.trace, rid=req.rid),
+                    "block_wait_t0": None}
+
+    def _entry(self, req) -> typing.Optional[dict]:
+        if req is None:
+            return None
+        return self._live.get(getattr(req, "rid", None))
+
+    def _span(self, entry, name, start_s, dur_s, **fields) -> None:
+        entry["spans"].add(name, start_s, dur_s, **fields)
+        tracectx.record_span(entry["trace"], name, start_s, dur_s,
+                             rid=entry["req"].rid, **fields)
+
+    def hook(self, event: str, **kw) -> None:
+        try:
+            self._record(event, **kw)
+        except Exception as exc:
+            import warnings
+            warnings.warn(f"request tracer hook failed: {exc!r}")
+
+    def _record(self, event: str, **kw) -> None:
+        now = self.clock()
+        if event == "admitted":
+            entry = self._entry(kw.get("req"))
+            if entry is None:
+                return
+            waited = float(kw.get("queue_age") or 0.0)
+            self._span(entry, "queue_wait", now - waited, waited)
+            t0 = entry.get("block_wait_t0")
+            if t0 is not None:
+                entry["block_wait_t0"] = None
+                self._span(entry, "kv_block_wait", t0, now - t0)
+        elif event == "kv_block_wait":
+            entry = self._entry(kw.get("req"))
+            if entry is not None and entry.get("block_wait_t0") is None:
+                entry["block_wait_t0"] = now
+        elif event == "chunk":
+            dt = float(kw.get("dt") or 0.0)
+            phase = kw.get("phase") or "decode"
+            # resident is the scheduler's live slot -> (req, admitted_ts)
+            # dict, passed by reference (no per-chunk copy on untraced
+            # deployments); snapshot the values here, tracer-side
+            for req, _ in list((kw.get("resident") or {}).values()):
+                entry = self._entry(req)
+                if entry is not None:
+                    self._span(entry, f"chunk/{phase}", now - dt, dt,
+                               steps=int(kw.get("steps") or 0))
+        elif event == "spec_verify":
+            # accept/reject rounds are fleet-level events (no per-request
+            # attribution inside one verify): cross-process record only
+            flight.record("spec_verify",
+                          drafted=int(kw.get("drafted") or 0),
+                          accepted=int(kw.get("accepted") or 0))
+
+    def finish(self, req, outcome: str) -> None:
+        entry = self._live.pop(getattr(req, "rid", None), None)
+        if entry is None:
+            return
+        try:
+            now = self.clock()
+            t0 = req.submitted_ts or now
+            self._span(entry, "request", t0, now - t0, outcome=outcome)
+            if self.dir is not None:
+                self._exported.append(entry["spans"].dump(self.dir))
+                while len(self._exported) > self.MAX_EXPORTS:
+                    import os as _os
+                    try:
+                        _os.remove(self._exported.popleft())
+                    except OSError:
+                        pass
+        except Exception as exc:
+            import warnings
+            warnings.warn(f"request trace export failed: {exc!r}")
+
+    def wrap_answer(self, answer: typing.Callable) -> typing.Callable:
+        def wrapped(req, outcome):
+            # answer FIRST: the per-request export is file IO on the
+            # device-loop thread (a remote model_path makes it an object-
+            # store PUT) — it must never sit between a finished request
+            # and its response reaching the HTTP child
+            out = answer(req, outcome)
+            self.finish(req, outcome[0])
+            return out
+        return wrapped
+
+    def wrap_hooks(self, hooks: typing.Callable) -> typing.Callable:
+        def wrapped(event, **kw):
+            self.hook(event, **kw)
+            return hooks(event, **kw)
+        return wrapped
+
+
 def _engine_classify(handlers, interface, responses, group, clock):
     """Split one drained IPC group for the engine loop: tokenizer-only
     paths answer inline (never touch the device — breaker-exempt, like the
@@ -1251,7 +1457,8 @@ def _engine_classify(handlers, interface, responses, group, clock):
         new_requests.append(EngineRequest(
             rid=rid, path=path, toks=toks, temperature=temp,
             response_len=rl, top_k=tk, top_p=tp, rep_penalty=rp,
-            deadline=deadline, enqueue_ts=enqueue))
+            deadline=deadline, enqueue_ts=enqueue,
+            trace=g[5] if len(g) > 5 else None))
     return new_requests
 
 
@@ -1284,6 +1491,25 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     import queue as queue_mod
     guard = ServingGuard(params)
     cfg = serve_config(params)
+    # request tracing + serving blackboxes (docs/OBSERVABILITY.md): armed
+    # by trace_requests — the device loop and the HTTP child then each
+    # leave a per-process event file next to the model's checkpoints, and
+    # every accepted completion carries a trace id end to end
+    trace_on = bool(getattr(params, "trace_requests", False)) \
+        and bool(params.model_path)
+    if trace_on:
+        if not flight.recorder().configured:
+            # replica processes configure first (their tag carries the
+            # replica index); the single-deployment default is "serve"
+            flight.configure(params.model_path, "serve",
+                             capacity=getattr(params,
+                                              "telemetry_blackbox_events",
+                                              4096))
+        cfg["trace"] = True
+        cfg["blackbox"] = {
+            "model_path": params.model_path,
+            "tag": f"{flight.recorder().tag or 'serve'}_http",
+            "events": getattr(params, "telemetry_blackbox_events", 4096)}
     # spawn, not fork: the parent's JAX/TPU runtime is multithreaded by now
     # and forking it can deadlock the child even though the child never
     # touches JAX.  _http_child's args are all picklable.
@@ -1301,6 +1527,7 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
     # the controller the host-side scheduling, and this loop only feeds them
     executor = _resolve_engine(params, interface)
     controller = None
+    tracer = None
     if executor is not None:
         from .scheduler import EngineController, SlotScheduler
         scheduler = SlotScheduler(executor.slots)
@@ -1308,13 +1535,21 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
         def _respond(rid, payload):
             responses[rid] = {"t": time.monotonic(), "r": payload}
 
+        answer = _engine_answer_fn(interface, _respond)
+        hooks = _engine_hooks_fn(interface, scheduler, executor)
+        if trace_on:
+            # the tracer only OBSERVES (chained in front of the metrics
+            # hooks, around the answer fn): greedy output stays
+            # byte-identical with tracing on — pinned by test
+            tracer = _RequestTracer(params.model_path)
+            answer = tracer.wrap_answer(answer)
+            hooks = tracer.wrap_hooks(hooks)
         controller = EngineController(
             executor, scheduler, guard=guard,
             decode_chunk=int(getattr(params, "decode_chunk_tokens", 64)),
             prefill_chunk=int(getattr(params, "serve_prefill_chunk_tokens",
                                       128) or 128),
-            answer=_engine_answer_fn(interface, _respond),
-            hooks=_engine_hooks_fn(interface, scheduler, executor))
+            answer=answer, hooks=hooks)
     engine_info = {"mode": "continuous" if controller else "batch",
                    "slots": executor.slots if executor else 0}
     if hasattr(executor, "spec_summary"):
@@ -1443,6 +1678,8 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                     new_reqs = _engine_classify(handlers, interface,
                                                 responses, group,
                                                 time.monotonic)
+                    if tracer is not None:
+                        tracer.begin(new_reqs)
                     controller.round(new_reqs)
                     # THE admission-budget fix (docs/SERVING.md): requests
                     # the loop drained into the engine — queued behind the
@@ -1463,7 +1700,11 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
                     state["inflight"] = 0
             except (EOFError, BrokenPipeError, ConnectionError, OSError):
                 break
+            if trace_on:
+                flight.maybe_flush(2.0)
     finally:
+        if trace_on:
+            flight.flush(reason="serve-exit")
         proc.terminate()
         proc.join(timeout=5.0)
         manager.shutdown()

@@ -46,6 +46,8 @@ import urllib.error
 import urllib.request
 
 from .. import telemetry
+from ..telemetry import events as flight
+from ..telemetry import tracectx
 from .serving_guard import CircuitBreaker, HTTPStatusError
 
 #: endpoints the router forwards verbatim to a replica
@@ -85,13 +87,16 @@ class Replica:
 
 
 def _http_transport(replica: Replica, path: str, body: dict,
-                    timeout: float) -> typing.Tuple[int, dict]:
+                    timeout: float,
+                    headers: typing.Optional[dict] = None
+                    ) -> typing.Tuple[int, dict]:
     """Default transport: POST the body to the replica, return
     ``(status, payload)``.  Connection-level failures raise (the router
-    counts them as replica failures and retries elsewhere)."""
+    counts them as replica failures and retries elsewhere).  ``headers``
+    (the trace-id propagation) merge over the content type."""
     req = urllib.request.Request(
         replica.base_url + path, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read())
@@ -146,13 +151,18 @@ class Router:
                  affinity_tokens: int = 32, affinity_slack: int = 4,
                  forward_timeout_s: float = 150.0,
                  transport: typing.Callable = _http_transport,
-                 clock: typing.Callable[[], float] = time.monotonic):
+                 clock: typing.Callable[[], float] = time.monotonic,
+                 trace_requests: bool = False):
         self.replicas = list(replicas)
         self.affinity_tokens = int(affinity_tokens)
         self.affinity_slack = int(affinity_slack)
         self.forward_timeout_s = float(forward_timeout_s)
         self.transport = transport
         self.clock = clock
+        #: request tracing (docs/OBSERVABILITY.md): the router MINTS the
+        #: trace id (or adopts the client's header) and propagates it to
+        #: the replica, recording a router/forward span per attempt
+        self.trace_requests = bool(trace_requests)
         #: prefix key -> replica index, LRU-capped
         self._affinity: "collections.OrderedDict[tuple, int]" = \
             collections.OrderedDict()
@@ -231,13 +241,20 @@ class Router:
 
     # -- forwarding ----------------------------------------------------------
 
-    def forward(self, path: str, body: dict) -> dict:
+    def forward(self, path: str, body: dict,
+                headers: typing.Optional[dict] = None) -> dict:
         """Pick + transport with one cross-replica retry.  5xx answers and
         connection failures count into the source replica's breaker; 2xx
-        and 4xx (client errors) count as replica health."""
+        and 4xx (client errors) count as replica health.  With tracing on,
+        the client's trace header (or a freshly minted id) propagates to
+        the replica and a router/forward span records each attempt."""
+        trace = None
+        if self.trace_requests:
+            trace = tracectx.trace_id_from_headers(headers) \
+                or tracectx.new_trace_id()
         first = self.pick(path, body)
         try:
-            return self._forward_one(first, path, body)
+            return self._forward_one(first, path, body, trace)
         except HTTPStatusError as e:
             if e.status < 500:
                 raise
@@ -245,18 +262,28 @@ class Router:
             if not retry_on:
                 raise
             second = min(retry_on, key=lambda r: (r.inflight, r.index))
-            return self._forward_one(second, path, body)
+            return self._forward_one(second, path, body, trace)
 
-    def _forward_one(self, replica: Replica, path: str, body: dict) -> dict:
+    def _forward_one(self, replica: Replica, path: str, body: dict,
+                     trace: typing.Optional[str] = None) -> dict:
         replica.begin()
         self._m_inflight.labels(replica=str(replica.index)).set(
             replica.inflight)
+        t0 = self.clock()
+        outcome = "ok"
         try:
-            status, payload = self.transport(replica, path, body,
-                                             self.forward_timeout_s)
+            if trace is not None:
+                status, payload = self.transport(
+                    replica, path, body, self.forward_timeout_s,
+                    headers={tracectx.TRACE_HEADER: trace})
+            else:
+                status, payload = self.transport(replica, path, body,
+                                                 self.forward_timeout_s)
         except HTTPStatusError:
+            outcome = "error"
             raise
         except Exception as e:  # connection refused / reset / timeout
+            outcome = "unreachable"
             replica.failures += 1
             replica.breaker.record_failure()
             self._m_requests.labels(replica=str(replica.index),
@@ -266,6 +293,13 @@ class Router:
                       "code": "bad_gateway"})
         finally:
             replica.done()
+            if trace is not None:
+                # the router-dispatch hop: one span per forward ATTEMPT
+                # (the cross-replica retry records its own), into the
+                # router process's blackbox
+                tracectx.record_span(trace, "router/forward", t0,
+                                     self.clock() - t0,
+                                     replica=replica.index, outcome=outcome)
             self._m_inflight.labels(replica=str(replica.index)).set(
                 replica.inflight)
             self._m_breaker.labels(replica=str(replica.index)).set(
@@ -368,6 +402,15 @@ def serve_replicated(params, workers: int = 1,
                          f"got {n}")
     port = DEFAULT_PORT if port is None else int(port)
     telemetry.register_build_info()
+    trace_on = bool(getattr(params, "trace_requests", False)) \
+        and bool(getattr(params, "model_path", ""))
+    if trace_on:
+        # the router's own blackbox (docs/OBSERVABILITY.md 'Request
+        # tracing'): router/forward spans land here, next to the replicas'
+        # event files, so forensics --trace merges the whole hop chain
+        flight.configure(params.model_path, "router",
+                         capacity=getattr(params,
+                                          "telemetry_blackbox_events", 4096))
     fleet = ReplicaFleet(params, n, base_port=port + 1)
     router = Router(
         [Replica(i, port + 1 + i,
@@ -380,12 +423,13 @@ def serve_replicated(params, workers: int = 1,
         affinity_tokens=int(getattr(params, "serve_affinity_tokens", 32)),
         affinity_slack=int(getattr(params, "serve_affinity_slack", 4)),
         forward_timeout_s=float(getattr(params, "serve_request_deadline_s",
-                                        120.0)) + 30.0)
+                                        120.0)) + 30.0,
+        trace_requests=trace_on)
     if control is not None:
         control["router"] = router
         control["fleet"] = fleet
 
-    def dispatch(path: str, body: dict) -> dict:
+    def dispatch(path: str, body: dict, headers=None) -> dict:
         if path == "/health":
             payload = router.health()
             if payload["status"] != "ok":
@@ -398,7 +442,7 @@ def serve_replicated(params, workers: int = 1,
             return payload
         if path == "/metrics":
             return {"_prometheus": router.metrics()}
-        return router.forward(path, body)
+        return router.forward(path, body, headers)
 
     paths = list(FORWARD_PATHS) + ["/health", "/ready", "/metrics"]
     # the fleet spawns NON-daemonic model-loading processes: everything
@@ -419,9 +463,13 @@ def serve_replicated(params, workers: int = 1,
               f":{port + 1}..:{port + n}")
         while stop is None or not stop.is_set():
             fleet.poll()
+            if trace_on:
+                flight.maybe_flush(2.0)
             if stop is None:
                 time.sleep(1.0)
             else:
                 stop.wait(1.0)
     finally:
+        if trace_on:
+            flight.flush(reason="router-exit")
         fleet.stop()

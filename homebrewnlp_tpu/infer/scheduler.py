@@ -48,6 +48,10 @@ class EngineRequest:
     deadline: typing.Optional[float] = None    # monotonic; None = none
     enqueue_ts: typing.Optional[float] = None  # HTTP-child admission stamp
     submitted_ts: float = 0.0                  # set by SlotScheduler.submit
+    #: cross-process trace id (docs/OBSERVABILITY.md 'Request tracing'):
+    #: minted at the router / HTTP edge, riding the request tuple; None
+    #: when tracing is off — the scheduler never reads it, it only carries
+    trace: typing.Optional[str] = None
 
     def prompt_len(self, seq: int) -> int:
         """Prompt tokens the decode keeps (clipped to capacity, matching
@@ -218,6 +222,10 @@ class EngineController:
         self.hooks = hooks or (lambda event, **kw: None)
         #: per-slot first-token-reported flags (TTFT closes exactly once)
         self._first_done: typing.Dict[int, bool] = {}
+        #: what the LAST planned dispatch was doing ("prefill" while any
+        #: resident is still walking its prompt, else "decode") — rides the
+        #: chunk hook so the request tracer can name chunk-occupancy spans
+        self.last_phase = "decode"
 
     # -- helpers -------------------------------------------------------------
 
@@ -234,7 +242,9 @@ class EngineController:
                          - int(self.executor.q[slot]))
             walk = max(walk, remaining)
         if walk > 0:
+            self.last_phase = "prefill"
             return max(1, min(self.prefill_chunk, walk))
+        self.last_phase = "decode"
         return self.decode_chunk
 
     def _fail_residents(self, exc: Exception) -> None:
@@ -265,7 +275,7 @@ class EngineController:
         for slot, req in evicted:
             self.executor.release(slot)
             self._first_done.pop(slot, None)
-            self.hooks("evicted")
+            self.hooks("evicted", req=req)
             self.answer(req, ("timeout", "slot"))
         breaker = self.guard.breaker.tick() if self.guard is not None \
             else "closed"
@@ -296,9 +306,16 @@ class EngineController:
                     break
                 self.executor.admit(one[0][0], one[0][1])
                 admitted += one
+            if self.sched.pending and self.sched.free_slots > 0 \
+                    and (limit is None or len(admitted) < limit):
+                # admission stopped at the FIFO head with slots free: the
+                # head is waiting on KV blocks, not a slot — surface it so
+                # the request tracer can close a block-wait span at its
+                # eventual admission (docs/OBSERVABILITY.md)
+                self.hooks("kv_block_wait", req=self.sched.pending[0])
         for slot, req, waited in admitted:
             self._first_done[slot] = False
-            self.hooks("admitted", queue_age=waited)
+            self.hooks("admitted", queue_age=waited, req=req)
         if not self.sched.resident:
             return False
         steps = self._plan_steps()
@@ -330,8 +347,12 @@ class EngineController:
             thr = max(1, req.prompt_len(seq))
             generated += max(0, int(q_after[slot])
                              - max(int(q_before[slot]), thr - 1))
+        # resident is the scheduler's LIVE dict (slot -> (req, admitted)),
+        # not a copy: only the request tracer consumes it, and building a
+        # per-chunk list would tax every untraced deployment's hot loop
         self.hooks("chunk", dt=dt, steps=advanced, generated=generated,
-                   cache_bytes=getattr(self.executor, "cache_bytes", 0))
+                   cache_bytes=getattr(self.executor, "cache_bytes", 0),
+                   phase=self.last_phase, resident=self.sched.resident)
         # paged executor: per-chunk block-pool occupancy + sharing stats
         # flow through the same hook seam (rest_api exports the hbnlp_kv_*
         # gauges from them; the scheduler stays engine-flavor-agnostic)
@@ -357,6 +378,6 @@ class EngineController:
             # (the stepped loop's flush_first_tokens rule)
             if not self._first_done.pop(slot, True):
                 self.hooks("first_token", reqs=[req])
-            self.hooks("recycled", residency=residency)
+            self.hooks("recycled", residency=residency, req=req)
             self.answer(req, ("ok", tokens))
         return True

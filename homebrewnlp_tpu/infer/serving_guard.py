@@ -229,6 +229,11 @@ class CircuitBreaker:
             self.state = "open"
             self.open_until = self.clock() + self.cooldown_s
             self.opened += 1
+            # breaker trips are incident anchors: into the flight recorder
+            # (docs/OBSERVABILITY.md 'Flight recorder'), never the hot path
+            from ..telemetry import events as _flight
+            _flight.record("breaker", state="open", failures=self.failures,
+                           trips=self.opened)
 
     def record_success(self):
         self.failures = 0
@@ -236,6 +241,8 @@ class CircuitBreaker:
             # a successful probe (or a straggler decode finishing cleanly
             # after the trip) is direct evidence the device is healthy again
             self.state = "closed"
+            from ..telemetry import events as _flight
+            _flight.record("breaker", state="closed", trips=self.opened)
 
     def retry_after(self) -> float:
         return max(0.0, self.open_until - self.clock())

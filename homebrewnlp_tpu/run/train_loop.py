@@ -24,6 +24,7 @@ from ..config import ModelParameter
 from ..core import sharding as shardlib
 from ..data.inputs import (Prefetcher, TextDataset, append_runs_log,
                            read_runs_log)
+from ..telemetry import events as flight
 from ..model import Model
 from ..train import Trainer
 from ..train import checkpoint as ckpt
@@ -278,6 +279,16 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     if is_chief:
         _dump_run_config(params)
 
+    # ---- flight recorder (docs/OBSERVABILITY.md 'Flight recorder'):
+    # typed rare events into a bounded ring, dumped as
+    # <model_path>/blackbox_p<rank>.jsonl on every exit path.  Recording is
+    # UNCONDITIONAL (independent of telemetry_enabled) but never touches
+    # the registry and never runs per step — step records ride the
+    # metric-log cadence, everything else is genuinely rare.
+    from ..distributed.elastic import generation as _elastic_generation
+    flight.configure(params.model_path, f"p{jax.process_index()}",
+                     capacity=params.telemetry_blackbox_events)
+
     # async checkpointing (docs/DISTRIBUTED.md): cadence + emergency saves
     # go through the double-buffered background saver — the step thread pays
     # only the device->host staging copy.  Every process routes through the
@@ -317,6 +328,11 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             restored = ckpt.restore(params.model_path, agreed) \
                 if agreed >= 0 else None
     params.current_step = restored[2] if restored else ckpt.latest_step(params.model_path)
+    flight.record("run_start", rank=jax.process_index(),
+                  world=jax.process_count(), gen=_elastic_generation(),
+                  step=int(params.current_step))
+    if restored:
+        flight.record("restore", step=int(restored[2]))
 
     data = make_dataset(params, mesh=mesh)
     first_batch = next(iter(data))
@@ -385,15 +401,51 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                         for entry in log:
                             f.write(json.dumps(entry) + "\n")
 
+    # host-side step mirror for the lease heartbeat + straggler detector:
+    # a plain list-cell assignment per loop turn, never a registry call —
+    # the zero-call hot-path contract is untouched
+    progress_ref = [int(params.current_step)]
+    #: telemetry-gated straggler counter, bound later (the registry block
+    #: below runs after the agent starts); the agent's callback reads the
+    #: cell at flag time
+    straggler_counter: typing.List[typing.Any] = [None]
+    # will hold the chrome-trace recorder once the telemetry block builds
+    # it; the force-exit hook below dumps whatever is there at exit time
+    tel_trace = None
+
+    def _force_exit_flush():
+        """Everything ``os._exit`` would lose, shared by the agent's
+        force-exit hook (the finally path never runs there): the chief's
+        DataLog rewrite and the chrome-trace ring.  The blackbox itself is
+        flushed by the agent AFTER this hook — satellite: the span trace
+        ring flushes on the membership exit path too, not just close."""
+        if datalog_flush is not None:
+            datalog_flush()
+        if tel_trace is not None and is_chief:
+            try:
+                tel_trace.dump(fs.join(params.model_path,
+                                       "telemetry_trace.json"))
+            except Exception as e:
+                print(f"WARNING: force-exit chrome trace dump failed: {e}",
+                      flush=True)
+
     if params.elastic_training and jax.process_count() > 1:
         from ..distributed.elastic import ElasticAgent
+
+        def _on_straggler(rank, stall_s, median_s):
+            counter = straggler_counter[0]
+            if counter is not None:
+                counter.inc()
 
         elastic_agent = ElasticAgent(
             params.model_path, jax.process_index(), jax.process_count(),
             interval_s=params.elastic_lease_interval_s,
             timeout_s=params.elastic_lease_timeout_s,
             exit_grace_s=params.elastic_exit_grace_s,
-            pre_exit=datalog_flush).start()
+            pre_exit=_force_exit_flush,
+            progress=lambda: progress_ref[0],
+            straggler_factor=params.elastic_straggler_factor,
+            on_straggler=_on_straggler).start()
         print(f"elastic: lease agent started (generation "
               f"{elastic_agent.gen}, world size {jax.process_count()}, "
               f"interval {params.elastic_lease_interval_s}s, timeout "
@@ -417,7 +469,6 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     # ONCE, outside the loop; when telemetry_enabled is false, `phases` is
     # None and the step loop makes exactly zero registry calls
     phases = None
-    tel_trace = None
     tel_nonfinite = tel_preempt = None
     tel_jsonl = None
     tel_jsonl_last = [0.0]
@@ -465,6 +516,12 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 "membership-change exits (peer lease lapse or coordinator "
                 "loss; resumed by the elastic controller from the freshest "
                 "complete checkpoint)")
+            if params.elastic_straggler_factor > 0:
+                straggler_counter[0] = reg.counter(
+                    "hbnlp_elastic_straggler_flags_total",
+                    "slow-but-alive ranks flagged by the chief's straggler "
+                    "detector (step-time skew vs fleet median, before the "
+                    "lease lapses)")
         # live MFU (docs/OBSERVABILITY.md 'Cost attribution'): analytical
         # forward FLOPs traced ONCE here (abstract — no device work), the
         # per-step gauge is ledger-FLOPs / measured step time / peak.
@@ -497,12 +554,15 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 print(f"WARNING: MFU gauge disabled (FLOP trace failed: "
                       f"{exc})", flush=True)
         if is_chief and params.telemetry_jsonl_interval_s > 0:
-            tel_jsonl = fs.open_(fs.join(params.model_path,
-                                         "telemetry.jsonl"), "a")
-            # header line: every later snapshot line in this file joins
-            # back to the build that produced it
-            tel_jsonl.write(json.dumps(
-                {"build_info": telemetry.build_info()}) + "\n")
+            # size-capped rotation (telemetry_max_file_mb, keep-last-N):
+            # a long run's trajectory can no longer fill the disk.  The
+            # header line — rewritten into every rotated generation — joins
+            # each file back to the build that produced it
+            tel_jsonl = telemetry.RotatingJsonl(
+                fs.join(params.model_path, "telemetry.jsonl"),
+                max_mb=params.telemetry_max_file_mb,
+                keep=params.telemetry_keep_files,
+                header=json.dumps({"build_info": telemetry.build_info()}))
             tel_jsonl.flush()
         # cross-host merge (docs/DISTRIBUTED.md): non-chief hosts publish
         # their (process-labeled) snapshots over the coordination KV store
@@ -541,6 +601,10 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             os.path.join(params.model_path, "profile"),
             params.telemetry_profile_steps)
         profiler_od.install_signal()
+    # SIGUSR2 also dumps the blackbox on demand; installed AFTER the
+    # profiler so the chained handler serves both (flush, then delegate) —
+    # and uninstalled FIRST on the way out (LIFO, before profiler close)
+    flight_unsig = flight.recorder().install_signal()
     total_steps = train_steps if train_steps is not None else params.train_steps
     tokens_per_step = (params.train_batch_size * params.sequence_length
                        * params.macro_batching)
@@ -648,6 +712,13 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             if profiler_od is not None:
                 profiler_od.poll(step_now)
             it_count += 1
+            # ENTRY semantics for the straggler detector: publish the step
+            # being ATTEMPTED before dispatching it.  Completion-based
+            # progress equalizes under synchronous collectives (every
+            # rank's dispatch blocks on the fleet), so the discriminating
+            # signal is the rank that never ARRIVED at the step its peers
+            # already entered — the classic barrier-arrival skew
+            progress_ref[0] = step_now + params.macro_batching
             if phases is None:
                 state, metrics = trainer.step(state, batch)
             else:
@@ -683,6 +754,8 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     nonfinite_streak += 1
                     if tel_nonfinite is not None:
                         tel_nonfinite.inc()
+                    flight.record("nonfinite", step=step_now,
+                                  streak=nonfinite_streak)
                     print(f"WARNING: non-finite loss ({loss_now}) at step "
                           f"{step_now}; update skipped "
                           f"({nonfinite_streak}/"
@@ -738,6 +811,11 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
             if ran_eval or step_now % log_every < params.macro_batching:
                 last_metrics = {**last_metrics,
                                 **{k: float(v) for k, v in metrics.items()}}
+                # step record at the metric-log cadence (NOT per step —
+                # the float conversions above already paid the sync)
+                flight.record("step", step=step_now,
+                              loss=last_metrics.get("loss"),
+                              consumed=consumed)
                 if logger is not None:
                     logger.log(step_now, metrics,
                                tokens_per_step=params.train_batch_size * params.sequence_length)
@@ -770,6 +848,12 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
         # path exists to write
         try:
             try:
+                if flight_unsig is not None:
+                    # LIFO: restore the chained SIGUSR2 handler BEFORE the
+                    # profiler's own uninstall (profiler_od.close below),
+                    # or its restore would strand our stale chain
+                    flight_unsig()
+                    flight_unsig = None
                 if elastic_agent is not None and not membership \
                         and sys.exc_info()[0] is None:
                     # normal completion / graceful 143: stop the lease
@@ -868,6 +952,27 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                     except Exception as e:
                         print(f"WARNING: chrome trace dump failed: {e}",
                               flush=True)
+                # blackbox dump on EVERY exit that reaches this finally:
+                # normal completion, the 143 emergency-save path, the
+                # clean half of a membership exit, and any crash unwind
+                # (the 144 force-exit path flushes via the agent instead)
+                try:
+                    exc_type = sys.exc_info()[0]
+                    why = ("membership" if membership
+                           else "preempted" if stopped
+                           else "crash" if exc_type is not None else "ok")
+                    flight.record(
+                        "exit", rank=jax.process_index(),
+                        gen=_elastic_generation(),
+                        code=(MEMBERSHIP_EXIT_CODE if membership
+                              else PREEMPTED_EXIT_CODE if stopped
+                              else 1 if exc_type is not None else 0),
+                        reason=why, step=progress_ref[0],
+                        error=exc_type.__name__ if exc_type else None)
+                    flight.flush(reason=why)
+                except Exception as e:
+                    print(f"WARNING: blackbox exit dump failed: {e}",
+                          flush=True)
         finally:
             for sig, handler in prev_handlers.items():
                 signal.signal(sig, handler)

@@ -16,7 +16,9 @@ ZERO registry calls unless ``telemetry_enabled`` is set; rare-event layers
 (storage retries, checkpoint IO, serving decode rounds) record always —
 their cadence is storage/request-bound, never per-step.
 """
+from . import events, tracectx
 from .buildinfo import build_info, register_build_info
+from .events import FlightRecorder, RotatingJsonl
 from .profiler import OnDemandProfiler
 from .registry import (DEFAULT_BUCKETS, Registry, histogram_quantile,
                        jsonl_line, merge_snapshots, prometheus_text,
@@ -31,4 +33,5 @@ __all__ = [
     "with_labels",
     "SPAN_METRIC", "ChromeTrace", "Phase", "StepPhases", "span",
     "OnDemandProfiler", "build_info", "register_build_info",
+    "events", "tracectx", "FlightRecorder", "RotatingJsonl",
 ]

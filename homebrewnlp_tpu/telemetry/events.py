@@ -1,0 +1,341 @@
+"""Flight recorder: a bounded, thread-safe ring of typed events dumped as
+``<model_path>/blackbox_<tag>.jsonl`` on every exit path (docs/OBSERVABILITY.md
+'Flight recorder').
+
+The metrics registry answers "how fast"; this layer answers "what happened,
+in what order, across which processes" when a rank dies or a request goes
+slow.  Every layer records rare events unconditionally — step records at the
+metric-log cadence, membership/lease transitions, breaker trips,
+admission/eviction/recycle decisions, checkpoint commits, collective-phase
+markers, request-trace spans — into one ring per process:
+
+* the ring is BOUNDED (``telemetry_blackbox_events``), so a week-long run
+  keeps the freshest history and the recorder can never grow host memory;
+* events carry a per-process monotonic timestamp, a wall-clock anchor, and
+  a strictly increasing sequence number — ``scripts/forensics.py`` merges
+  the per-process dumps into one causally-ordered timeline, using
+  KV-observed orderings (a lease scan records which peer beat it saw) to
+  break monotonic-clock ties across hosts;
+* ``flush()`` rewrites the blackbox file from the ring: the train loop's
+  finally path, the exit-143 emergency save, the exit-144 membership
+  force-exit (the elastic agent's ``os._exit`` path — which skips every
+  ``finally`` — flushes through its pre-exit hook), and SIGUSR2 on demand
+  all route through it.  Flush failures warn and never kill the run.
+
+Stdlib-only like the registry: importable from the HTTP child subprocess
+and from tests without jax.  The registry's zero-call hot-path contract is
+untouched — the event layer never touches the registry, and the train step
+loop records nothing per step (step events ride the metric-log cadence).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import threading
+import time
+import typing
+
+
+def blackbox_path(model_path: str, tag: str) -> str:
+    from ..utils import fs
+    return fs.join(model_path, f"blackbox_{tag}.jsonl")
+
+
+def _json_safe(value):
+    try:
+        json.dumps(value)
+        return value
+    except TypeError:
+        return str(value)
+
+
+class FlightRecorder:
+    """Bounded ring of typed events + the blackbox dump discipline.
+
+    ``configure(model_path, tag)`` arms the dump target; ``record`` is safe
+    (and cheap — a lock + a deque append) from any thread whether or not a
+    target is armed.  ``clock``/``wall`` are injectable for deterministic
+    tests."""
+
+    def __init__(self, capacity: int = 4096,
+                 clock: typing.Callable[[], float] = time.monotonic,
+                 # the wall anchor is an epoch STAMP for cross-process
+                 # display, never duration arithmetic (forensics orders on
+                 # causality + monotonic)  # graft-lint: allow[wallclock]
+                 wall: typing.Callable[[], float] = time.time):
+        # REENTRANT: the SIGUSR2/SIGTERM flush handlers run on the main
+        # thread, which may be interrupted mid-``record`` holding this
+        # very lock — a plain Lock would deadlock the process inside its
+        # own signal handler
+        self._lock = threading.RLock()
+        self._events: typing.Deque[dict] = collections.deque(
+            maxlen=max(1, int(capacity)))
+        self._clock = clock
+        self._wall = wall
+        self._seq = 0
+        self._last_flush = 0.0
+        self._dirty = False
+        self.model_path: typing.Optional[str] = None
+        self.tag: typing.Optional[str] = None
+
+    # -- configuration --------------------------------------------------------
+
+    @property
+    def configured(self) -> bool:
+        return self.model_path is not None
+
+    def configure(self, model_path: str, tag: str,
+                  capacity: typing.Optional[int] = None) -> "FlightRecorder":
+        """Arm the dump target (idempotent; a second configure re-targets).
+        ``capacity`` <= 0 leaves the recorder in-memory only (ring keeps
+        recording, dumps are disabled)."""
+        with self._lock:
+            if capacity is not None and int(capacity) <= 0:
+                self.model_path = None
+                self.tag = str(tag)
+                return self
+            if capacity is not None and \
+                    int(capacity) != self._events.maxlen:
+                self._events = collections.deque(
+                    self._events, maxlen=max(1, int(capacity)))
+            self.model_path = str(model_path)
+            self.tag = str(tag)
+        return self
+
+    # -- recording ------------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one typed event; returns the event dict (tests)."""
+        ev = {"kind": str(kind)}
+        for k, v in fields.items():
+            ev[k] = _json_safe(v)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            ev["t"] = round(self._clock(), 6)
+            ev["wall"] = round(self._wall(), 6)
+            if self.tag is not None:
+                ev["proc"] = self.tag
+            self._events.append(ev)
+            self._dirty = True
+        return ev
+
+    def events(self, kind: typing.Optional[str] = None) -> typing.List[dict]:
+        with self._lock:
+            items = list(self._events)
+        if kind is None:
+            return items
+        return [e for e in items if e["kind"] == kind]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dirty = False
+
+    # -- the blackbox dump ----------------------------------------------------
+
+    def flush(self, reason: str = "") -> typing.Optional[str]:
+        """Rewrite the blackbox file from the ring (bounded work).  Returns
+        the path, or None when unconfigured / on write failure — a flush on
+        a dying exit path must never raise over the exit itself."""
+        with self._lock:
+            if self.model_path is None:
+                return None
+            path = blackbox_path(self.model_path, self.tag or "p0")
+            # events recorded BEFORE configure() carry no proc tag: stamp
+            # them at dump time so the merged timeline can attribute them
+            items = [ev if "proc" in ev else dict(ev, proc=self.tag)
+                     for ev in self._events]
+            header = {"blackbox": {"tag": self.tag, "ospid": os.getpid(),
+                                   "events": len(items),
+                                   "reason": reason or "flush"}}
+            self._dirty = False
+            self._last_flush = self._clock()
+        try:
+            from ..utils import fs
+            fs.makedirs(self.model_path)
+            with fs.open_(path, "w") as f:
+                f.write(json.dumps(header) + "\n")
+                for ev in items:
+                    f.write(json.dumps(ev) + "\n")
+            return path
+        except Exception as e:
+            try:
+                print(f"WARNING: blackbox flush failed: {e}", flush=True)
+            except Exception:
+                pass
+            return None
+
+    def maybe_flush(self, min_interval_s: float = 1.0
+                    ) -> typing.Optional[str]:
+        """Throttled flush: at most one dump per ``min_interval_s``, and
+        only when something was recorded since the last one — the cheap
+        call request-serving loops sprinkle so a SIGKILLed process leaves a
+        recent (if not final) blackbox behind."""
+        with self._lock:
+            if self.model_path is None or not self._dirty:
+                return None
+            if self._clock() - self._last_flush < min_interval_s:
+                return None
+        return self.flush(reason="periodic")
+
+    def install_signal(self, signum: int = signal.SIGUSR2
+                       ) -> typing.Optional[typing.Callable[[], None]]:
+        """SIGUSR2 dumps the blackbox on demand.  CHAINS the previously
+        installed handler (the on-demand profiler shares the signal), so
+        install this LAST and UNINSTALL it first (LIFO) via the returned
+        callable — restoring out of order would strand a stale chained
+        handler.  Returns None outside the main thread."""
+        try:
+            prev = signal.getsignal(signum)
+
+            def _handler(sig, frame):
+                # deque append/list() are safe here; the flush itself runs
+                # file IO in the handler — acceptable for an ops signal
+                self.flush(reason="signal")
+                if callable(prev) and prev not in (signal.SIG_IGN,
+                                                   signal.SIG_DFL):
+                    prev(sig, frame)
+
+            signal.signal(signum, _handler)
+
+            def _uninstall():
+                try:
+                    signal.signal(signum, prev)
+                except (ValueError, OSError, TypeError):
+                    pass
+
+            return _uninstall
+        except (ValueError, OSError):
+            return None
+
+
+# ---- process-wide instance --------------------------------------------------
+
+_recorder = FlightRecorder()
+_recorder_lock = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """The process-wide flight recorder every layer records into."""
+    return _recorder
+
+
+def set_recorder(rec: typing.Optional[FlightRecorder] = None
+                 ) -> FlightRecorder:
+    """Swap the process-wide recorder (tests isolate themselves); ``None``
+    installs a fresh one.  Returns the PREVIOUS recorder."""
+    global _recorder
+    with _recorder_lock:
+        prev = _recorder
+        _recorder = rec if rec is not None else FlightRecorder()
+    return prev
+
+
+def record(kind: str, **fields) -> dict:
+    return _recorder.record(kind, **fields)
+
+
+def configure(model_path: str, tag: str,
+              capacity: typing.Optional[int] = None) -> FlightRecorder:
+    return _recorder.configure(model_path, tag, capacity)
+
+
+def flush(reason: str = "") -> typing.Optional[str]:
+    return _recorder.flush(reason)
+
+
+def maybe_flush(min_interval_s: float = 1.0) -> typing.Optional[str]:
+    return _recorder.maybe_flush(min_interval_s)
+
+
+# ---- size-capped jsonl rotation (satellite: telemetry.jsonl growth) ---------
+
+class RotatingJsonl:
+    """Append-only JSONL writer with size-capped rotation: when the current
+    file passes ``max_mb`` it rotates to ``<path>.1`` (older files shift to
+    ``.2`` … ``.keep``; beyond that they are deleted) and a fresh file opens
+    with the ``header`` line rewritten, so every generation of the file is
+    self-describing.  ``max_mb`` <= 0 = unbounded (the historical behavior).
+    Rotation needs rename, so REMOTE paths (gs://…) stay unbounded with a
+    one-time warning; the local spool case — where week-long runs actually
+    fill disks — is the one that rotates."""
+
+    def __init__(self, path: str, max_mb: float = 0.0, keep: int = 2,
+                 header: typing.Optional[str] = None):
+        from ..utils import fs
+        self._fs = fs
+        self.path = str(path)
+        self.keep = max(1, int(keep))
+        self.header = header
+        self._local = fs.is_local(self.path)
+        self._max_bytes = int(float(max_mb) * (1 << 20)) \
+            if self._local else 0
+        if not self._local and float(max_mb) > 0:
+            print(f"WARNING: telemetry_max_file_mb ignored for remote path "
+                  f"{self.path} (rotation needs rename)", flush=True)
+        self._f = fs.open_(self.path, "a")
+        try:
+            self._size = os.path.getsize(self.path) if self._local else 0
+        except OSError:
+            self._size = 0
+        if self.header is not None:
+            # every open (and every rotation) writes the header line, so
+            # each file generation is self-describing — the historical
+            # append-a-header-per-run behavior, kept
+            self._write_raw(self.header)
+
+    def _write_raw(self, line: str) -> None:
+        if not line.endswith("\n"):
+            line += "\n"
+        self._f.write(line)
+        self._size += len(line.encode())
+
+    def _rotate(self) -> None:
+        self._f.close()
+        try:
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            # drop EVERY generation beyond keep (contiguous scan): the
+            # shift loop above overwrites `.keep` in place, so after an
+            # operator SHRINKS telemetry_keep_files the higher-numbered
+            # orphans from the old setting must still be reclaimed
+            i = self.keep + 1
+            while os.path.exists(f"{self.path}.{i}"):
+                os.remove(f"{self.path}.{i}")
+                i += 1
+            os.replace(self.path, f"{self.path}.1")
+        finally:
+            # reopen WHATEVER the path now names — the fresh file, or (if
+            # a rename failed: ENOSPC, permissions) the original one — so
+            # a rotation failure degrades to appending, never to a closed
+            # handle that turns every later write into a ValueError
+            self._f = self._fs.open_(self.path, "a")
+            try:
+                self._size = os.path.getsize(self.path)
+            except OSError:
+                self._size = 0
+        if self._size == 0 and self.header is not None:
+            self._write_raw(self.header)
+
+    def write(self, line: str) -> None:
+        if self._max_bytes and self._size >= self._max_bytes:
+            try:
+                self._rotate()
+            except OSError as e:
+                print(f"WARNING: telemetry rotation failed: {e}", flush=True)
+                self._max_bytes = 0  # degrade to unbounded, not a crash loop
+        self._write_raw(line)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass

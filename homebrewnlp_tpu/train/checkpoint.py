@@ -251,10 +251,19 @@ def save(model_path: str, step: int, variables: typing.Dict[str, jax.Array],
     import time as _time
     t_save = _time.monotonic()
     try:
-        return _save_inner(model_path, step, variables, opt_state, max_keep,
-                           extra)
+        out = _save_inner(model_path, step, variables, opt_state, max_keep,
+                          extra)
     finally:
         _metrics()[1].labels(op="save").observe(_time.monotonic() - t_save)
+    # flight-recorder checkpoint marker (docs/OBSERVABILITY.md 'Flight
+    # recorder'): the commit is the recovery point every forensic timeline
+    # anchors on, and the cadence flush keeps a SIGKILLed rank's blackbox
+    # at-most-one-checkpoint stale
+    from ..telemetry import events as _flight
+    _flight.record("checkpoint_commit", step=int(step),
+                   seconds=round(_time.monotonic() - t_save, 3))
+    _flight.maybe_flush()
+    return out
 
 
 def _save_inner(model_path: str, step: int, variables, opt_state,

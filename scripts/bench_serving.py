@@ -269,7 +269,7 @@ def _build_interface(config_path=None, latency=None):
 
 
 def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
-           block_tokens: int = 8):
+           block_tokens: int = 8, trace_dir=None):
     from homebrewnlp_tpu.config import ModelParameter
     from homebrewnlp_tpu.infer import rest_api
 
@@ -279,6 +279,12 @@ def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
     # must fail the A/B loudly, not silently measure the plain engine
     serve_engine = ("continuous" if engine in ("spec", "paged")
                     else engine)
+    trace_over = {}
+    if trace_dir:
+        # --trace: per-request span export (docs/OBSERVABILITY.md 'Request
+        # tracing') under a scratch model_path, so the per-hop breakdown
+        # never writes into a real run directory
+        trace_over = {"trace_requests": True, "model_path": str(trace_dir)}
     params = ModelParameter(interface.params,
                             serve_engine=serve_engine, serve_slots=slots,
                             serve_batch_size=batch,
@@ -286,7 +292,7 @@ def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
                             kv_block_tokens=block_tokens,
                             spec_decode="draft" if engine == "spec"
                             else "off",
-                            spec_draft_tokens=spec_k)
+                            spec_draft_tokens=spec_k, **trace_over)
     params.train = False
     # /health's decode_path reads the INTERFACE's params (FaultyInterface
     # proxies); the spec knobs themselves ride the resolved `params`
@@ -303,11 +309,11 @@ def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
     return port, stop, t
 
 
-def _post(port, payload, timeout=180.0):
+def _post(port, payload, timeout=180.0, headers=None):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/token_completion",
         data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read())
@@ -419,7 +425,8 @@ def _request_for(rng, i, orbit=None):
     return {"tokens": toks, "max_tokens": mt, "temperature": 0.0}, plen
 
 
-def _closed_loop(port, rng, workers: int, per_worker: int, orbit=None):
+def _closed_loop(port, rng, workers: int, per_worker: int, orbit=None,
+                 trace_ids=None):
     stats = _Stats()
     # payloads pre-drawn on this thread: numpy Generators are not
     # thread-safe, and racy draw order would break --seed reproducibility
@@ -427,13 +434,24 @@ def _closed_loop(port, rng, workers: int, per_worker: int, orbit=None):
                  for i in range(per_worker)] for w in range(workers)]
 
     def worker(w):
+        from homebrewnlp_tpu.telemetry import tracectx
         for payload, plen in payloads[w]:
+            headers = None
+            if trace_ids is not None:
+                # --trace: the CLIENT mints the id (header adoption at the
+                # HTTP edge), so the per-hop files are findable afterwards
+                tid = tracectx.new_trace_id()
+                headers = {tracectx.TRACE_HEADER: tid}
+            t_req = time.monotonic()
             try:
-                status, body = _post(port, payload)
+                status, body = _post(port, payload, headers=headers)
             except Exception:
                 stats.record(599, {}, plen)
                 continue
             stats.record(status, body, plen)
+            if trace_ids is not None and status == 200:
+                with stats.lock:
+                    trace_ids.append((tid, time.monotonic() - t_req))
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
@@ -473,6 +491,44 @@ def _open_loop(port, rng, rate_rps: float, duration_s: float, orbit=None):
     return stats, wall
 
 
+def _hop_breakdown(trace_dir, trace_ids) -> dict:
+    """p50/p99 per-hop seconds over the traced closed-loop requests:
+    queue-wait / prefill / decode (+ kv-block-wait when paged), plus the
+    client-measured dispatch overhead (client wall minus the in-engine
+    request span).  Reads the per-request exports the tracer wrote under
+    <trace_dir>/traces/.  The router-dispatch hop of a REPLICATED
+    deployment lives in the router process's blackbox, not these files —
+    merge it with ``scripts/forensics.py --trace <id>``."""
+    import numpy as np
+    per_hop: dict = {}
+    dispatch_overhead = []
+    found = 0
+    for tid, wall in trace_ids:
+        path = os.path.join(trace_dir, "traces", f"trace_{tid}.json")
+        try:
+            with open(path) as f:
+                hops = json.load(f).get("hops") or {}
+        except (OSError, ValueError):
+            continue
+        found += 1
+        for key in ("queue_wait", "kv_block_wait", "prefill", "decode"):
+            if key in hops:
+                per_hop.setdefault(key, []).append(hops[key])
+        if "request" in hops:
+            dispatch_overhead.append(max(0.0, wall - hops["request"]))
+    out = {"traced_requests": found}
+    for key, vals in sorted(per_hop.items()):
+        out[key] = {"p50": round(float(np.percentile(vals, 50)), 6),
+                    "p99": round(float(np.percentile(vals, 99)), 6),
+                    "n": len(vals)}
+    if dispatch_overhead:
+        out["dispatch"] = {
+            "p50": round(float(np.percentile(dispatch_overhead, 50)), 6),
+            "p99": round(float(np.percentile(dispatch_overhead, 99)), 6),
+            "n": len(dispatch_overhead)}
+    return out
+
+
 def run_engine(engine: str, args, latency=None, spec_ctx=None) -> dict:
     import numpy as np
     orbit = None
@@ -482,8 +538,13 @@ def run_engine(engine: str, args, latency=None, spec_ctx=None) -> dict:
         interface.draft = draft if engine == "spec" else None
     else:
         interface = _build_interface(args.config, latency=latency)
+    trace_dir = None
+    if getattr(args, "trace", False):
+        import tempfile
+        trace_dir = tempfile.mkdtemp(prefix=f"bench_trace_{engine}_")
     port, stop, t = _spawn(interface, engine, args.slots, args.batch,
-                           spec_k=getattr(args, "spec_k", 8))
+                           spec_k=getattr(args, "spec_k", 8),
+                           trace_dir=trace_dir)
     try:
         health = _wait_up(port)
         served = "continuous" if engine == "spec" else engine
@@ -507,8 +568,10 @@ def run_engine(engine: str, args, latency=None, spec_ctx=None) -> dict:
         time.sleep(1.5)
         baseline = _scrape_buckets(port)
         spec_before = _scrape_spec(port) if engine == "spec" else None
+        trace_ids = [] if trace_dir else None
         closed, closed_wall = _closed_loop(port, rng, args.concurrency,
-                                           args.requests, orbit=orbit)
+                                           args.requests, orbit=orbit,
+                                           trace_ids=trace_ids)
         open_stats, open_wall = _open_loop(port, rng, args.rate,
                                            args.duration, orbit=orbit)
         time.sleep(1.5)   # final snapshot publish
@@ -542,6 +605,16 @@ def run_engine(engine: str, args, latency=None, spec_ctx=None) -> dict:
                 "accept_rate": round(accepted / max(drafted, 1.0), 4),
                 "state": after["state"],
             }
+        if trace_ids is not None:
+            # per-hop latency anatomy of the closed-loop window (ISSUE 15
+            # satellite): where a request's wall time actually went
+            row["hops"] = _hop_breakdown(trace_dir, trace_ids)
+            if engine == "batch" and not row["hops"]["traced_requests"]:
+                # an explicit absence, not a zero that reads like a
+                # collection failure
+                row["hops"]["note"] = ("batch engine untraced — request "
+                                       "tracing rides the continuous "
+                                       "engine's hooks")
         return row
     finally:
         stop.set()
@@ -950,6 +1023,14 @@ def main(argv=None) -> int:
                          "width k+1; tokens per round scale with it at "
                          "high acceptance — measured 1.5x at k=12, 2.0x "
                          "at k=16 on the CPU rig)")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable request tracing on the served deployment "
+                         "and record a p50/p99 per-hop breakdown "
+                         "(queue-wait / prefill / decode / dispatch "
+                         "overhead) of the closed-loop window into each "
+                         "row's 'hops' key; the replicated tier's "
+                         "router-dispatch hop merges via forensics.py "
+                         "--trace (docs/OBSERVABILITY.md)")
     ap.add_argument("--check", action="store_true",
                     help="exit nonzero unless continuous >= 1.5x batch "
                          "closed-loop tokens/sec AND lower p99 TTFT; with "
@@ -1059,20 +1140,25 @@ def main(argv=None) -> int:
         result["spec_canary_parity"] = (
             by["spec"]["canary"] is not None
             and by["spec"]["canary"] == by["continuous"]["canary"])
+    payload = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            payload = prior if isinstance(prior, dict) else {}
+        except ValueError:
+            payload = {}
     if args.spec:
         # the spec round rides BENCH_SERVING.json NEXT TO the PR 7
         # continuous-vs-batch row instead of overwriting it
-        payload = {}
-        if os.path.exists(args.out):
-            try:
-                with open(args.out) as f:
-                    prior = json.load(f)
-                payload = prior if isinstance(prior, dict) else {}
-            except ValueError:
-                payload = {}
         payload["spec"] = result
     else:
-        payload = result
+        # the headline row is the top level; re-measuring it must not
+        # drop the nested spec/shared_prefix/replicas rows other modes
+        # merged in earlier
+        extra = {k: payload[k] for k in ("spec", "shared_prefix",
+                                         "replicas") if k in payload}
+        payload = {**result, **extra}
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=1)
     print(json.dumps({k: v for k, v in result.items() if k != "rows"}),

@@ -1,0 +1,388 @@
+#!/usr/bin/env python3
+"""Merge per-process flight-recorder blackboxes into ONE causally-ordered
+incident timeline (docs/OBSERVABILITY.md 'Flight recorder').
+
+Every process of a run — train ranks (``blackbox_p<rank>.jsonl``), the
+serving router (``blackbox_router.jsonl``), replicas and their HTTP
+children — dumps a bounded ring of typed events on every exit path.  Each
+file is internally ordered (per-process ``seq``), but wall clocks skew
+across hosts, so a naive sort-by-timestamp can invert cause and effect.
+This tool orders CAUSALLY:
+
+* within a process, events keep their sequence order;
+* across processes, a lease scan that OBSERVED peer p's beat s
+  happened-after p recorded beat s (the coordination-KV ordering the
+  elastic agents already establish) — these edges pin the cross-process
+  skeleton, and the wall clock only breaks the remaining ties.
+
+The incident summary names the FIRST-FAILING rank: a rank that peers
+declared lapsed but that recorded no exit of its own (its blackbox — if
+one exists at all — ends mid-flight) was killed from outside; survivors'
+membership records show, in causal order, who noticed first and how the
+pod died.
+
+Usage::
+
+    python scripts/forensics.py <model_path>                # the timeline
+    python scripts/forensics.py <model_path> --json         # machine form
+    python scripts/forensics.py <model_path> --trace <id>   # one request
+    python scripts/forensics.py file1.jsonl file2.jsonl     # explicit set
+
+Stdlib-only and jax-free: runs on a laptop against blackboxes rsynced off
+a dead pod.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import heapq
+import json
+import os
+import sys
+import typing
+
+
+def load_blackbox(path: str) -> typing.Tuple[str, typing.List[dict]]:
+    """One blackbox file -> (tag, events).  The header line names the tag;
+    malformed lines are skipped rather than failing the merge (a file torn
+    mid-write is exactly the incident case)."""
+    tag = os.path.basename(path)
+    if tag.startswith("blackbox_"):
+        tag = tag[len("blackbox_"):]
+    if tag.endswith(".jsonl"):
+        tag = tag[:-len(".jsonl")]
+    events: typing.List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "blackbox" in obj:
+                tag = obj["blackbox"].get("tag") or tag
+                continue
+            if "kind" in obj:
+                events.append(obj)
+    return tag, events
+
+
+def load_files(paths: typing.Sequence[str]) -> typing.Dict[str, list]:
+    files: typing.Dict[str, list] = {}
+    for path in paths:
+        tag, events = load_blackbox(path)
+        files.setdefault(tag, []).extend(events)
+    return files
+
+
+def discover(model_path: str) -> typing.List[str]:
+    return sorted(glob.glob(os.path.join(model_path, "blackbox_*.jsonl")))
+
+
+# ---- causal merge -----------------------------------------------------------
+
+def causal_order(files: typing.Dict[str, typing.List[dict]]
+                 ) -> typing.List[dict]:
+    """Merge per-process event lists into one order: per-process sequence +
+    beat->observation edges, wall-clock tie-break (Kahn's algorithm over a
+    happens-before DAG, ready set keyed by wall time so the output is
+    deterministic and readable)."""
+    nodes: typing.List[typing.Tuple[str, int]] = []
+    events: typing.Dict[typing.Tuple[str, int], dict] = {}
+    for tag, evs in files.items():
+        for i, ev in enumerate(sorted(evs, key=lambda e: e.get("seq", 0))):
+            node = (tag, i)
+            nodes.append(node)
+            events[node] = dict(ev, proc=ev.get("proc", tag))
+    succ: typing.Dict[tuple, typing.List[tuple]] = {n: [] for n in nodes}
+    indeg: typing.Dict[tuple, int] = {n: 0 for n in nodes}
+
+    def edge(a: tuple, b: tuple) -> None:
+        succ[a].append(b)
+        indeg[b] += 1
+
+    for tag, evs in files.items():
+        count = sum(1 for n in nodes if n[0] == tag)
+        for i in range(count - 1):
+            edge((tag, i), (tag, i + 1))
+    # beat index: rank -> sorted [(beat seq, node)]
+    beats: typing.Dict[int, typing.List[typing.Tuple[int, tuple]]] = {}
+    for node in nodes:
+        ev = events[node]
+        if ev.get("kind") == "beat" and "rank" in ev and "beat" in ev:
+            beats.setdefault(int(ev["rank"]), []).append(
+                (int(ev["beat"]), node))
+    for v in beats.values():
+        v.sort()
+    for node in nodes:
+        ev = events[node]
+        if ev.get("kind") != "lease_scan":
+            continue
+        for pid_s, seen_seq in (ev.get("peers") or {}).items():
+            try:
+                pid, seen_seq = int(pid_s), int(seen_seq)
+            except (TypeError, ValueError):
+                continue
+            # the LATEST beat at/below the observed seq happened-before
+            # this scan (the killed rank's file may be missing — no edge)
+            best = None
+            for bseq, bnode in beats.get(pid, ()):
+                if bseq <= seen_seq:
+                    best = bnode
+                else:
+                    break
+            if best is not None and best[0] != node[0]:
+                edge(best, node)
+    ready = [( events[n].get("wall", 0.0), events[n].get("seq", 0), n)
+             for n in nodes if indeg[n] == 0]
+    heapq.heapify(ready)
+    out: typing.List[dict] = []
+    while ready:
+        _, _, node = heapq.heappop(ready)
+        out.append(events[node])
+        for nxt in succ[node]:
+            indeg[nxt] -= 1
+            if indeg[nxt] == 0:
+                heapq.heappush(ready, (events[nxt].get("wall", 0.0),
+                                       events[nxt].get("seq", 0), nxt))
+    if len(out) < len(nodes):  # a cycle (clock-skewed duplicate files):
+        seen = {id(e) for e in out}  # degrade to wall order, never crash
+        rest = [events[n] for n in nodes if id(events[n]) not in seen]
+        out.extend(sorted(rest, key=lambda e: e.get("wall", 0.0)))
+    return out
+
+
+# ---- incident analysis ------------------------------------------------------
+
+def analyze(files: typing.Dict[str, typing.List[dict]]) -> dict:
+    """The incident summary: first-failing rank(s), per-survivor lapse
+    observations in causal order, membership exits, stragglers."""
+    timeline = causal_order(files)
+    # the INCIDENT generation: a rank killed before its new incarnation's
+    # first flush leaves its PREVIOUS generation's ring (ending in a clean
+    # exit) on disk — exits and lapse records from older generations must
+    # not exonerate it, so everything below filters to the newest
+    # generation any membership event names (None = no gen stamps at all)
+    gens = [ev.get("gen") for ev in timeline
+            if ev.get("kind") == "membership" and ev.get("gen") is not None]
+    incident_gen = max(gens) if gens else None
+
+    def _in_incident(ev: dict) -> bool:
+        return incident_gen is None or ev.get("gen") is None \
+            or ev.get("gen") == incident_gen
+
+    exits: typing.Dict[str, dict] = {}
+    memberships: typing.List[dict] = []
+    stragglers: typing.List[dict] = []
+    lapsed_named: typing.Set[int] = set()
+    for ev in timeline:
+        kind = ev.get("kind")
+        if kind == "exit":
+            if incident_gen is not None \
+                    and ev.get("gen") != incident_gen:
+                continue  # a stale prior-generation ring's clean exit
+            exits[ev.get("proc", "?")] = ev
+        elif kind == "membership":
+            if not _in_incident(ev):
+                continue
+            memberships.append(ev)
+            for pid in ev.get("lapsed") or []:
+                try:
+                    lapsed_named.add(int(pid))
+                except (TypeError, ValueError):
+                    pass
+        elif kind == "straggler":
+            stragglers.append(ev)
+    # a lapsed rank with NO exit record of its own died from outside — the
+    # first-failing rank.  Its blackbox (if any survived an earlier flush)
+    # simply stops; survivors' exits are 143/144/crash records.
+    exited_ranks: typing.Set[int] = set()
+    for ev in exits.values():
+        if "rank" in ev:
+            try:
+                exited_ranks.add(int(ev["rank"]))
+            except (TypeError, ValueError):
+                pass
+    killed = sorted(lapsed_named - exited_ranks)
+    observations = [{"observer": ev.get("proc"), "cause": ev.get("cause"),
+                     "lapsed": ev.get("lapsed"), "wall": ev.get("wall")}
+                    for ev in memberships]
+    return {
+        "processes": sorted(files),
+        "events": len(timeline),
+        "first_failing_rank": killed[0] if killed else None,
+        "killed_ranks": killed,
+        "lapse_observations": observations,
+        "membership_exits": [
+            {"proc": tag, "code": ev.get("code"), "path": ev.get("path"),
+             "reason": ev.get("reason"), "cause": ev.get("cause")}
+            for tag, ev in sorted(exits.items())
+            if ev.get("code") == 144 or ev.get("path") == "force"],
+        "exits": {tag: {"code": ev.get("code"),
+                        "path": ev.get("path") or ev.get("reason")}
+                  for tag, ev in sorted(exits.items())},
+        "stragglers": [{"rank": ev.get("rank"),
+                        "stall_s": ev.get("stall_s")} for ev in stragglers],
+        "timeline": timeline,
+    }
+
+
+_VERBOSE_FIELDS = ("kind", "proc", "seq", "t", "wall")
+
+
+def format_timeline(timeline: typing.Sequence[dict],
+                    limit: int = 0) -> str:
+    """Human form: one line per event, relative wall time, the process it
+    came from, and the payload fields."""
+    if not timeline:
+        return "(no events)"
+    base = min(ev.get("wall", 0.0) for ev in timeline)
+    lines = []
+    shown = timeline if not limit else timeline[-limit:]
+    if limit and len(timeline) > limit:
+        lines.append(f"... ({len(timeline) - limit} earlier events elided; "
+                     "use --limit 0 for all)")
+    for ev in shown:
+        rel = ev.get("wall", base) - base
+        fields = " ".join(f"{k}={ev[k]!r}" for k in sorted(ev)
+                          if k not in _VERBOSE_FIELDS)
+        lines.append(f"[+{rel:9.3f}s] {ev.get('proc', '?'):<10} "
+                     f"{ev.get('kind', '?'):<18} {fields}")
+    return "\n".join(lines)
+
+
+def format_report(report: dict, limit: int = 0) -> str:
+    lines = ["== forensics: merged flight-recorder timeline ==",
+             f"processes: {', '.join(report['processes'])} "
+             f"({report['events']} events)"]
+    if report["first_failing_rank"] is not None:
+        lines.append(f"FIRST-FAILING RANK: p{report['first_failing_rank']} "
+                     "(declared lapsed by peers, no exit record of its own "
+                     "— killed from outside)")
+        if len(report["killed_ranks"]) > 1:
+            lines.append(f"  (all killed ranks: "
+                         f"{report['killed_ranks']})")
+    else:
+        lines.append("no killed rank identified (no lapse without a "
+                     "matching exit record)")
+    if report["lapse_observations"]:
+        lines.append("lapse observations (causal order):")
+        for i, obs in enumerate(report["lapse_observations"]):
+            lines.append(f"  {i + 1}. {obs['observer']}: {obs['cause']} "
+                         f"(lapsed={obs['lapsed']})")
+    if report["membership_exits"]:
+        lines.append("membership exits (144 / force path):")
+        for ex in report["membership_exits"]:
+            lines.append(f"  {ex['proc']}: code={ex['code']} "
+                         f"path={ex['path']}")
+    if report["stragglers"]:
+        lines.append("straggler flags: " + ", ".join(
+            f"p{s['rank']} (+{s['stall_s']}s)"
+            for s in report["stragglers"]))
+    lines.append("")
+    lines.append(format_timeline(report["timeline"], limit=limit))
+    return "\n".join(lines)
+
+
+# ---- per-request trace merge (--trace) --------------------------------------
+
+def trace_report(files: typing.Dict[str, typing.List[dict]],
+                 trace_id: str,
+                 model_path: typing.Optional[str] = None) -> dict:
+    """All spans of one trace id across every process's events (plus the
+    per-request export under <model_path>/traces when present), as one
+    merged per-request view with the per-hop breakdown."""
+    spans: typing.List[dict] = []
+    for tag, evs in files.items():
+        for ev in evs:
+            if ev.get("kind") == "span" and ev.get("trace") == trace_id:
+                spans.append({"name": ev.get("name", "?"),
+                              "t0": float(ev.get("t0", 0.0)),
+                              "dur": float(ev.get("dur", 0.0)),
+                              "proc": ev.get("proc", tag)})
+    exported = None
+    if model_path:
+        path = os.path.join(model_path, "traces", f"trace_{trace_id}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                exported = json.load(f)
+    hops: typing.Dict[str, float] = {}
+    for s in spans:
+        key = s["name"].split("/", 1)[1] if s["name"].startswith("chunk/") \
+            else s["name"]
+        hops[key] = round(hops.get(key, 0.0) + s["dur"], 6)
+    return {"trace_id": trace_id, "spans": sorted(spans,
+                                                  key=lambda s: s["t0"]),
+            "hops": hops, "exported": exported}
+
+
+def format_trace(report: dict) -> str:
+    lines = [f"== trace {report['trace_id']} =="]
+    for s in report["spans"]:
+        lines.append(f"  [{s['t0']:14.6f} +{s['dur'] * 1e3:9.3f}ms] "
+                     f"{s['proc']:<12} {s['name']}")
+    lines.append("per-hop totals (seconds):")
+    for k, v in sorted(report["hops"].items()):
+        lines.append(f"  {k:<16} {v:.6f}")
+    return "\n".join(lines)
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+",
+                    help="a model_path (blackbox_*.jsonl discovered inside)"
+                         " or explicit blackbox files")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--trace", default=None,
+                    help="merge ONE request's spans instead of the "
+                         "incident timeline")
+    ap.add_argument("--limit", type=int, default=200,
+                    help="show at most the last N timeline events "
+                         "(0 = all)")
+    args = ap.parse_args(argv)
+    paths: typing.List[str] = []
+    model_path = None
+    for inp in args.inputs:
+        if os.path.isdir(inp):
+            model_path = model_path or inp
+            found = discover(inp)
+            if not found:
+                print(f"forensics: no blackbox_*.jsonl under {inp}",
+                      file=sys.stderr)
+                return 2
+            paths.extend(found)
+        elif os.path.exists(inp):
+            paths.append(inp)
+        else:
+            print(f"forensics: no such file or directory: {inp}",
+                  file=sys.stderr)
+            return 2
+    files = load_files(paths)
+    if not any(files.values()):
+        print("forensics: blackbox files held no events", file=sys.stderr)
+        return 2
+    if args.trace:
+        report = trace_report(files, args.trace, model_path=model_path)
+        if not report["spans"] and report["exported"] is None:
+            print(f"forensics: no spans for trace {args.trace!r}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(report, indent=2) if args.json
+              else format_trace(report))
+        return 0
+    report = analyze(files)
+    if args.json:
+        out = dict(report)
+        out["timeline"] = out["timeline"][-args.limit:] if args.limit \
+            else out["timeline"]
+        print(json.dumps(out, indent=2))
+    else:
+        print(format_report(report, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

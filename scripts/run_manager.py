@@ -255,6 +255,18 @@ class Fleet(Manager):
             self.out(f"[p{pid}] {line.rstrip()}")
         stream.close()
 
+    def join_pumps(self, timeout: float = 10.0) -> None:
+        """Drain the reader threads through teardown: a dying rank's LAST
+        lines — written during the TERM→KILL grace window, exactly the
+        forensically interesting ones (membership markers, emergency-save
+        progress, tracebacks) — land in the manager log before the next
+        generation launches or the manager exits.  Called after every
+        fleet teardown; the threads see EOF once their process is dead, so
+        the joins are bounded."""
+        for t in self._pump_threads:
+            t.join(timeout=timeout)
+        self._pump_threads = []
+
     def launch_fleet(self) -> typing.List[subprocess.Popen]:
         n = self.num_processes
         port = _free_port()  # fresh per generation: no TIME_WAIT rebind race
@@ -291,6 +303,10 @@ class Fleet(Manager):
         for p in procs:
             if p.poll() is None:
                 self.kill(p, grace=grace)
+        # every worker is down: drain its remaining output before the
+        # caller relaunches or returns (the last lines of a dying rank
+        # must not race the reader thread's demise)
+        self.join_pumps()
 
     def terminate_fleet(self, procs, grace: typing.Optional[int] = None):
         """Graceful pod-wide stop: SIGTERM EVERY worker first (the shape a
@@ -316,6 +332,14 @@ class Fleet(Manager):
         self.kill_fleet(procs, grace=15)
 
     def run(self):
+        try:
+            self._run_fleet_loop()
+        finally:
+            # clean finishes and give-ups alike: drain the readers so the
+            # final worker lines are in the log before the manager exits
+            self.join_pumps()
+
+    def _run_fleet_loop(self):
         procs = self.launch_fleet()
         restarts = 0
         while True:
@@ -450,6 +474,12 @@ class ElasticFleet(Fleet):
                 and self._capacity_ok())
 
     def run(self):
+        try:
+            self._run_elastic_loop()
+        finally:
+            self.join_pumps()
+
+    def _run_elastic_loop(self):
         self.out(f"elastic controller: target {self.target} processes, "
                  f"model_path {self.args.model_path}")
         procs = self.launch_fleet()

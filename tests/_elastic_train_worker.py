@@ -120,6 +120,26 @@ def main() -> int:
         i = args.index("--step-delay")
         step_delay = float(args[i + 1])
         del args[i:i + 2]
+    # per-rank straggle (forensics_test straggler e2e): ONE rank's host
+    # wedges for --straggle-delay seconds at step --straggle-step while
+    # its lease agent keeps beating — the slow-but-alive shape (GC pause,
+    # storage stall) the chief's straggler detector must flag.  In
+    # synchronous training a merely-proportionally-slower rank equalizes
+    # the whole fleet's step rate (collectives gate everyone), so the
+    # detectable — and operationally real — shape is the one-shot wedge
+    straggle_rank, straggle_delay, straggle_step = -1, 0.0, 3
+    if "--straggle-rank" in args:
+        i = args.index("--straggle-rank")
+        straggle_rank = int(args[i + 1])
+        del args[i:i + 2]
+    if "--straggle-delay" in args:
+        i = args.index("--straggle-delay")
+        straggle_delay = float(args[i + 1])
+        del args[i:i + 2]
+    if "--straggle-step" in args:
+        i = args.index("--straggle-step")
+        straggle_step = int(args[i + 1])
+        del args[i:i + 2]
     probe_step = None
     if "--step" in args:
         i = args.index("--step")
@@ -176,6 +196,25 @@ def main() -> int:
             return orig_step(self, *a, **k)
 
         Trainer.step = slow_step
+
+    if straggle_rank == rank and straggle_delay > 0:
+        # the wedge lives in the DATA FETCH (a storage stall), blocking
+        # BEFORE this rank enters its next step: the step-entry progress
+        # the lease publishes then lags the fleet — the shape the chief's
+        # straggler detector keys on.  (A sleep inside the step call would
+        # land after the entry marker and be indistinguishable from peers
+        # blocked on this rank's own collective.)
+        from homebrewnlp_tpu.data.inputs import Prefetcher
+        orig_next = Prefetcher.__next__
+        fetches = [0]
+
+        def wedge_next(self):
+            fetches[0] += 1
+            if fetches[0] == straggle_step:
+                time.sleep(straggle_delay)
+            return orig_next(self)
+
+        Prefetcher.__next__ = wedge_next
 
     from homebrewnlp_tpu.run.train_loop import (MEMBERSHIP_EXIT_CODE,
                                                 PREEMPTED_EXIT_CODE, train)

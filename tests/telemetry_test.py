@@ -412,16 +412,39 @@ def train_step_phase_breakdown_test(tmp_path, fresh_registry):
     """Tentpole acceptance: with telemetry on, a train smoke run emits the
     data-wait / dispatch / device-block step-phase breakdown, prefetcher
     series, a telemetry.jsonl trajectory and a chrome trace; with it off,
-    the registry sees ZERO calls from the whole run."""
+    the registry sees ZERO calls from the whole run — INCLUDING from the
+    event layer, whose flight recorder keeps recording (rare-event cadence
+    only: step records at the log cadence, never per step, never into the
+    registry)."""
     from robustness_test import _train_cfg, _write_records
     from homebrewnlp_tpu.run import train_loop as tl
+    from homebrewnlp_tpu.telemetry import events as flight
 
     data_dir = _write_records(tmp_path)
     cfg = _train_cfg(tmp_path, data_dir, use_checkpointing=False)
-    result = tl.train(ModelParameter(cfg), log_every=2)
-    assert result["final_step"] == cfg["train_steps"]
-    assert fresh_registry.snapshot() == {}, \
-        "telemetry_enabled=false must make zero registry calls"
+    prev_rec = flight.set_recorder()
+    try:
+        result = tl.train(ModelParameter(cfg), log_every=2)
+        assert result["final_step"] == cfg["train_steps"]
+        assert fresh_registry.snapshot() == {}, \
+            "telemetry_enabled=false must make zero registry calls " \
+            "(event layer included)"
+        # the flight recorder recorded UNCONDITIONALLY — but at rare-event
+        # cadence: step events ride the log cadence, not the hot path
+        rec = flight.recorder()
+        kinds = {e["kind"] for e in rec.events()}
+        assert {"run_start", "exit"} <= kinds, kinds
+        steps = [e for e in rec.events() if e["kind"] == "step"]
+        assert 0 < len(steps) <= cfg["train_steps"] // 2 + 1, len(steps)
+        assert steps[-1]["loss"] is not None
+        # ... and the blackbox dump landed on the normal exit path
+        bb = os.path.join(cfg["model_path"], "blackbox_p0.jsonl")
+        lines = [json.loads(x) for x in open(bb)]
+        assert lines[0]["blackbox"]["tag"] == "p0"
+        exits = [x for x in lines if x.get("kind") == "exit"]
+        assert exits and exits[-1]["reason"] == "ok"
+    finally:
+        flight.set_recorder(prev_rec)
 
     cfg = _train_cfg(tmp_path, data_dir, use_checkpointing=False,
                      model_path=str(tmp_path / "run2"),
