@@ -19,6 +19,12 @@ donated-jit     every ``jax.jit(..., donate_argnums=...)`` site must be
                 registered in ``DONATED_JIT_REGISTRY`` so the HLO donation
                 audit (analysis/hlo_lint.py) covers it — an unregistered
                 donation is an unaudited 2x-HBM failure mode.
+engine-registry donated jit sites under ``infer/`` must be the Engine's
+                single chunk-program builder (``engine.py::_chunk_jit``) or
+                the batch sampler's — a donated jit anywhere else in the
+                serving tier is a forked carry layout escaping the
+                composition registry (``ENGINE_PROGRAMS``); new serving
+                features compose as registry rows, not new programs.
 mesh-axis-literal  hardcoded mesh-axis name strings ("data", "model",
                 "sequence", "pipe") in axis-consuming positions —
                 PartitionSpec/NamedSharding arguments, ``mesh.shape``
@@ -75,19 +81,25 @@ DONATED_JIT_REGISTRY: typing.Dict[str, str] = {
         "decode_chunk_step (harness)",
     "homebrewnlp_tpu/analysis/entry_points.py::lower_prefill_entry":
         "prefill_entry_step (harness)",
-    # the continuous-batching engine's chunk step (all three variants —
-    # init/admit/plain — share one jit site; the steady-state program is
-    # audited as "engine_chunk_step")
-    "homebrewnlp_tpu/infer/engine.py::_engine_jit": "engine_chunk_step",
-    # the speculative draft+verify chunk step (spec_init/spec_admit/
-    # spec_plain share one jit site; BOTH cache pools ride the donated
-    # carry and are audited as "spec_chunk_step")
-    "homebrewnlp_tpu/infer/engine.py::_spec_jit": "spec_chunk_step",
-    # the paged-KV engine chunk step (paged_init/paged_admit/paged_plain
-    # share one jit site; the KV block pools ride the donated carry and
-    # are audited as "paged_chunk_step")
-    "homebrewnlp_tpu/infer/paged.py::_paged_jit": "paged_chunk_step",
+    # the Engine's single chunk-program builder: every composition in
+    # infer/engine.py ENGINE_PROGRAMS (plain / spec / paged /
+    # spec-on-paged, each with init/admit/plain phases) lowers through
+    # this ONE jit site and is audited under its registry name
+    "homebrewnlp_tpu/infer/engine.py::_chunk_jit":
+        "engine_chunk_step, spec_chunk_step, paged_chunk_step, "
+        "spec_paged_chunk_step",
 }
+
+#: the Engine no-fork invariant (the ``engine-registry`` rule): donated
+#: jit sites under ``infer/`` build chunk programs, and the ONLY legal
+#: chunk-program builders are the Engine's single site and the batch
+#: sampler's.  A new donated jit anywhere else in ``infer/`` is a forked
+#: carry layout escaping the composition registry — add a row to
+#: ``ENGINE_PROGRAMS`` instead of a program.
+ENGINE_REGISTRY_SITES = frozenset((
+    "homebrewnlp_tpu/infer/engine.py::_chunk_jit",
+    "homebrewnlp_tpu/infer/sampler.py::_jit_sampler",
+))
 
 
 #: mesh-axis names the mesh-axis-literal rule polices (mirrors
@@ -248,6 +260,14 @@ class _FileVisitor(ast.NodeVisitor):
                           "register it and give it an HLO donation audit "
                           "(analysis/entry_points.py), or the donation can "
                           "silently stop aliasing")
+            if (self.rel.startswith("homebrewnlp_tpu/infer/")
+                    and key not in ENGINE_REGISTRY_SITES):
+                self._add("engine-registry", node,
+                          f"donated jit site {key!r} builds a chunk program "
+                          "outside the Engine registry — serving carries "
+                          "compose through infer/engine.py _chunk_jit "
+                          "(add an ENGINE_PROGRAMS row, not a forked "
+                          "program; docs/SERVING.md 'Engine architecture')")
         self.generic_visit(node)
 
 

@@ -52,10 +52,13 @@ AUDIT_CONFIG: typing.Dict[str, typing.Any] = {
                    "shared"]}],
 }
 
-#: audited entry points, in budgets.json key order
+#: audited entry points, in budgets.json key order.  The four ``*_chunk_
+#: step`` tails mirror ``infer/engine.py`` ``ENGINE_PROGRAMS`` — the
+#: Engine's composition registry (mirrored, not imported: this module must
+#: import without jax; the static-analysis tests pin the two in sync)
 ENTRY_POINTS = ("train_step", "decode_chunk_step", "prefill_entry_step",
                 "eval_fn", "engine_chunk_step", "spec_chunk_step",
-                "paged_chunk_step")
+                "paged_chunk_step", "spec_paged_chunk_step")
 
 #: KV block size for the paged-engine audit: a real multi-block geometry
 #: (seq 16 -> 4 blocks/slot) so the table gather/scatter machinery is
@@ -429,6 +432,88 @@ def lower_spec_step(model, variables, token_x, draft_model=None,
     return hlo, context
 
 
+def lower_spec_paged_step(model, variables, token_x, draft_model=None,
+                          draft_variables=None, mesh=None):
+    """Compiled donated SPEC-ON-PAGED chunk step — the composed program
+    (``infer/engine.py`` ``ENGINE_PROGRAMS["spec_paged_chunk_step"]``):
+    draft + width-(k+1) verify running over BLOCK POOLS for BOTH models,
+    gathered/scattered through the same read/write tables.  The donated
+    carry holds both pools at block geometry plus token_x/key/seen; the
+    audit pins every leaf of both pools aliased input->output with no
+    full-pool-shaped copy — composing the components must not cost a
+    resident duplicate of either pool.
+
+    Abstract avals throughout, same OOM-safety argument as
+    ``lower_decode_step``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..infer.engine import _chunk_jit
+    from ..infer.paged import classify_cache_leaves
+    from ..infer.sampler import decode_cache_shapes
+
+    if draft_model is None:
+        _, draft_model, draft_variables, _, _ = build_audit_model(
+            DRAFT_AUDIT_OVERRIDES, seed=1)
+    aval = jax.ShapeDtypeStruct
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    tps = token_x.shape[2]
+    bt = PAGED_AUDIT_BLOCK_TOKENS if seq % PAGED_AUDIT_BLOCK_TOKENS == 0 \
+        else 1
+    seq_blocks = seq // bt
+    num_blocks = batch * seq_blocks
+
+    def block_pools(shapes):
+        info = classify_cache_leaves(shapes, seq)
+        pools = {}
+        for n, s in shapes.items():
+            baxis, sax = info[n]
+            if sax is None:
+                pools[n] = aval(tuple(s.shape), s.dtype)
+            else:
+                ps = list(s.shape)
+                ps[baxis], ps[sax] = num_blocks, bt
+                pools[n] = aval(tuple(ps), s.dtype)
+        return pools
+
+    tshapes = decode_cache_shapes(model, variables, token_x)
+    dshapes = decode_cache_shapes(draft_model, draft_variables, token_x)
+    tpools = block_pools(tshapes)
+    dpools = block_pools(dshapes)
+    step = _chunk_jit(model, mesh, "plain", draft_model=draft_model,
+                      k=model.params.spec_draft_tokens,
+                      paged=(bt, num_blocks))
+    vec_i = aval((batch,), jnp.int32)
+    vec_f = aval((batch,), jnp.float32)
+    vec_b = aval((batch,), jnp.bool_)
+    key = aval(jax.random.PRNGKey(0).shape, jnp.uint32)
+    seen = aval((batch, model.params.vocab_size), jnp.float32)
+    table = aval((batch, seq_blocks), jnp.int32)
+    carry = (aval(tuple(token_x.shape), token_x.dtype), tpools, dpools,
+             key, seen)
+    fargs = (vec_i, vec_f, vec_f)
+    args = (variables, draft_variables, vec_i, vec_i, vec_f, vec_i, fargs,
+            vec_b, aval((batch, tps), jnp.int32), vec_b, vec_i, (), table,
+            table, carry)
+    compiled = step.lower(*args).compile()
+    hlo = compiled.as_text()
+    context = {
+        # token_x + key + seen ride the donated carry next to the two pools
+        "donated_leaves": len(tpools) + len(dpools) + 3,
+        "protected": (hlo_lint.shape_strings(tpools, key_filter="/kv")
+                      | hlo_lint.shape_strings(dpools, key_filter="/kv")),
+        "cache_shapes": {**tpools,
+                         **{"draft/" + k: v for k, v in dpools.items()}},
+        "bf16_params": (hlo_lint.shape_strings(variables, min_rank=2,
+                                               dtypes={"bf16"})
+                        | hlo_lint.shape_strings(draft_variables, min_rank=2,
+                                                 dtypes={"bf16"})),
+        "compiled": compiled,
+        "trace": lambda: step.trace(*args).jaxpr,
+    }
+    return hlo, context
+
+
 def _filter_args(batch: int, logits_filter: bool):
     import jax
     import jax.numpy as jnp
@@ -473,6 +558,9 @@ def lower_all(overrides: typing.Optional[dict] = None
                                              jnp.asarray(token_x),
                                              draft_model=dmodel,
                                              draft_variables=dvariables)
+    out["spec_paged_chunk_step"] = lower_spec_paged_step(
+        model, variables, jnp.asarray(token_x), draft_model=dmodel,
+        draft_variables=dvariables)
     return out
 
 
@@ -500,16 +588,17 @@ def lower_one(entry: str, overrides: typing.Optional[dict] = None
         return lower_engine_step(model, variables, jnp.asarray(token_x))
     if entry == "paged_chunk_step":
         return lower_paged_step(model, variables, jnp.asarray(token_x))
-    if entry == "spec_chunk_step":
+    if entry in ("spec_chunk_step", "spec_paged_chunk_step"):
         # the draft shares the caller's overrides (sequence geometry must
         # match the target — the lower_all merge rule)
         draft_overrides = dict(overrides or {})
         draft_overrides.update(DRAFT_AUDIT_OVERRIDES)
         _, dmodel, dvariables, _, _ = build_audit_model(draft_overrides,
                                                         seed=1)
-        return lower_spec_step(model, variables, jnp.asarray(token_x),
-                               draft_model=dmodel,
-                               draft_variables=dvariables)
+        lower = (lower_spec_step if entry == "spec_chunk_step"
+                 else lower_spec_paged_step)
+        return lower(model, variables, jnp.asarray(token_x),
+                     draft_model=dmodel, draft_variables=dvariables)
     return lower_prefill_entry(model, variables, jnp.asarray(token_x))
 
 
@@ -538,7 +627,7 @@ def audit_lowered(lowered: "typing.Dict[str, typing.Tuple[str, dict]]",
 
     for entry in ("decode_chunk_step", "prefill_entry_step",
                   "engine_chunk_step", "spec_chunk_step",
-                  "paged_chunk_step"):
+                  "paged_chunk_step", "spec_paged_chunk_step"):
         hlo, ctx = lowered[entry]
         findings += hlo_lint.audit(
             entry, hlo,

@@ -113,7 +113,8 @@ MESH_STRATEGIES: typing.Dict[str, MeshStrategy] = {
         "dp_tp",
         {"mesh_shape_override": {"data": 4, "model": 2}},
         entries=("train_step", "train_step_bucketed", "decode_chunk_step",
-                 "engine_chunk_step", "spec_chunk_step", "paged_chunk_step"),
+                 "engine_chunk_step", "spec_chunk_step", "paged_chunk_step",
+                 "spec_paged_chunk_step"),
         sharded_dims={"heads": "model"},
         collective_axes=frozenset({"data", "model"}),
         description="2-D data x tensor parallelism (heads over 'model')"),
@@ -440,7 +441,7 @@ def lower_serving_under_mesh(strategy: MeshStrategy, entry: str,
         # covers the sharded serving shape of the paged program
         hlo, ctx = entry_points.lower_paged_step(model, var_avals, tok,
                                                  mesh=mesh)
-    elif entry == "spec_chunk_step":
+    elif entry in ("spec_chunk_step", "spec_paged_chunk_step"):
         # the draft rides the same strategy at DRAFT_AUDIT_OVERRIDES width;
         # its param avals carry the same layout-rule shardings as the
         # target's, so the compiled program shards the draft pool too (the
@@ -458,10 +459,10 @@ def lower_serving_under_mesh(strategy: MeshStrategy, entry: str,
                 sharding=shardlib.named_sharding(
                     dparams, dmodel.param_dims.get(k, ()), mesh))
             for k, v in dvariables.items()}
-        hlo, ctx = entry_points.lower_spec_step(model, var_avals, tok,
-                                                draft_model=dmodel,
-                                                draft_variables=dvar_avals,
-                                                mesh=mesh)
+        lower = (entry_points.lower_spec_step if entry == "spec_chunk_step"
+                 else entry_points.lower_spec_paged_step)
+        hlo, ctx = lower(model, var_avals, tok, draft_model=dmodel,
+                         draft_variables=dvar_avals, mesh=mesh)
         # two models in one program share every leaf NAME (same scope paths
         # at two widths), so the by-name metadata join cannot tell target
         # from draft parameters: the spec entry keeps the cache-pool

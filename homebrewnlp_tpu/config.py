@@ -238,6 +238,13 @@ class ModelParameter:
         # pallas flash kernel for plain softmax dot-product attention
         # (single-device; map-bias flags and decode use the dense path)
         self.use_flash_attention = True
+        # pallas blocked kernel for the pure learned-map mixer
+        # (biased_attention_map WITHOUT dot_product — the flagship mixer):
+        # (bias . causal mask) @ value computed blockwise in VMEM with
+        # causally-dead blocks skipped.  Decode, prefill, non-128-multiple
+        # sequences and sequence-/pipe-sharded meshes keep the dense
+        # einsum (a loud fallback line names why)
+        self.use_map_mixer_kernel = True
         # stash each flash layer's (out, lse) during the forward so the
         # revnet/momentum backward's recompute skips the forward kernel
         # (model/blocks.py stash channels + flash_precomputed).  Opt-in:

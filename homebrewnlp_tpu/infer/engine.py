@@ -192,157 +192,375 @@ def _engine_loop(model: Model, mesh, variables, ipb, tb, end_pos, steps,
     return q, token_x, caches, key, seen
 
 
-def _engine_jit(model: Model, mesh, kind: str):
-    """Per-model cache of the jitted engine steps (mirrors
-    ``sampler._jit_sampler`` — a fresh closure per dispatch would re-trace
-    every chunk)."""
+# ------------------------------------------------------ the Engine substrate
+
+#: the Engine's chunk-program registry: every servable composition of the
+#: orthogonal donated-carry components, keyed by the name the HLO/mesh
+#: audits, ``budgets.json``, and ``cost_ledger.json`` know it by.  ONE
+#: builder (:func:`_chunk_jit`) lowers all of them — adding a composition
+#: is adding a row here, not forking a program (graft-lint's
+#: ``engine-registry`` AST rule pins the no-fork invariant).  Mirrored as
+#: the chunk-step tail of ``analysis/entry_points.py`` ``ENTRY_POINTS``
+#: (mirrored, not imported — that module must import without jax; the
+#: static-analysis tests pin the two in sync).
+ENGINE_PROGRAMS: typing.Dict[str, typing.Dict[str, bool]] = {
+    "engine_chunk_step": {"spec": False, "paged": False},
+    "spec_chunk_step": {"spec": True, "paged": False},
+    "paged_chunk_step": {"spec": False, "paged": True},
+    "spec_paged_chunk_step": {"spec": True, "paged": True},
+}
+
+
+def program_name(spec: bool, paged: bool) -> str:
+    """Registry name of the composition carrying the given components."""
+    for name, parts in ENGINE_PROGRAMS.items():
+        if parts["spec"] == bool(spec) and parts["paged"] == bool(paged):
+            return name
+    raise KeyError(f"no registered chunk program with spec={spec} "
+                   f"paged={paged}")
+
+
+def _spec_round(model: Model, draft_model: Model, mesh, k: int, variables,
+                dvariables, q, ipb, tb, end_pos, fargs, spec_mask, fix_tok,
+                fix_mask, seen_lo, token_x, caches, dcaches, key, seen):
+    """One draft+verify round over whatever cache pytrees the composition
+    carries — the slot pools, or the paged engine's gathered per-slot
+    views: host fix splice + repetition-penalty catch-up, k+1 sequential
+    draft steps, ONE width-(k+1) verify, sampled-token readback.  ONE
+    definition shared by ``spec_chunk_step`` and ``spec_paged_chunk_step``,
+    so the spec-vs-plain greedy parity contract cannot drift between the
+    two compositions (the ``_engine_loop`` rule)."""
+    import jax
+    import jax.numpy as jnp
+
+    batch, seq = token_x.shape[0], token_x.shape[1]
+    rows3 = jnp.arange(batch)[:, None, None]
+    end_pos = jnp.minimum(end_pos, seq)
+    qc = jnp.clip(q, 0, seq - 1)
+    # host accept/reject splice: the previous round's correction (or
+    # bonus) token lands at the row's NEW position q — the token this
+    # round's first draft step and verify offset 0 consume
+    old_q = jnp.take_along_axis(token_x, qc[:, None, None], axis=1)
+    fixed = jnp.where(fix_mask[:, None, None], fix_tok[:, None, :],
+                      old_q)
+    token_x = token_x.at[jnp.arange(batch), qc].set(
+        jnp.squeeze(fixed, 1))
+    # repetition-penalty catch-up for the tokens the previous round
+    # emitted: count positions (seen_lo, q] at/past the prompt boundary
+    # (prompt counts were seeded at admit) so `seen` again reflects the
+    # full context below the write position, the plain-body invariant
+    cm = ((jnp.arange(seq)[None, :, None] > seen_lo[:, None, None])
+          & (jnp.arange(seq)[None, :, None] <= q[:, None, None])
+          & (jnp.arange(seq)[None, :, None] >= ipb[:, None, None])
+          ).astype(jnp.float32)
+    seen = seen.at[rows3, token_x].add(cm)
+    active = q < end_pos - 1
+
+    # ---- draft: k+1 sequential quarter-width steps from each slot's
+    # position; k greedy draft tokens written (slots at depth 0 --
+    # spec_mask false -- consume but never write), the +1 step only
+    # fills the draft KV row at q+k so full acceptance leaves no gap
+    def dbody(i, st):
+        token_x, dcaches = st
+        qd = jnp.clip(q + i, 0, seq - 1)
+        cur = jnp.take_along_axis(token_x, qd[:, None, None], axis=1)
+        with jax.named_scope("draft"):
+            dlogits, dc = draft_model.apply_decode(dvariables, cur, qd,
+                                                   dcaches, mesh=mesh)
+        nxt = jnp.argmax(dlogits.astype(jnp.float32), axis=-1
+                         ).astype(token_x.dtype)
+        qp1 = qd + 1
+        old = jnp.take_along_axis(
+            token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
+        wr = active & spec_mask & (i < k) & (qp1 >= ipb)
+        new = jnp.where(wr[:, None, None], nxt, old)
+        token_x = token_x.at[jnp.arange(batch), qp1].set(
+            jnp.squeeze(new, 1), mode="drop")
+        return token_x, dc
+
+    token_x, dcaches = jax.lax.fori_loop(0, k + 1, dbody,
+                                         (token_x, dcaches))
+
+    # ---- verify: ONE width-(k+1) full-model step scores positions
+    # q..q+k per slot against the whole KV pool in a single cache read
+    vidx = jnp.clip(q[:, None] + jnp.arange(k + 1), 0, seq - 1)
+    vtok = jnp.take_along_axis(token_x, vidx[:, :, None], axis=1)
+    with jax.named_scope("verify"):
+        logits, caches = model.apply_decode(variables, vtok, qc, caches,
+                                            mesh=mesh)
+    with jax.named_scope("sampling"):
+        vt, key = _sample_logits(logits, seen, tb, fargs, key)
+        vt = vt.astype(token_x.dtype)
+    return token_x, caches, dcaches, key, seen, vt
+
+
+def _chunk_jit(model: Model, mesh, phase: str, *,
+               draft_model: typing.Optional[Model] = None,
+               k: typing.Optional[int] = None,
+               paged: typing.Optional[typing.Tuple[int, int]] = None):
+    """THE donated chunk-program builder — the Engine's single jit site.
+
+    Every composition in :data:`ENGINE_PROGRAMS` lowers through this one
+    function.  The donated carry is assembled from orthogonal components
+    instead of forked per program: token_x + the sampling state (q/seen —
+    q moves to a host-owned argument under spec) always ride; ``paged``
+    swaps the fixed slot stripes for ``[num_blocks, block_tokens, ...]``
+    block pools gathered/scattered through int32 read/write tables; a
+    ``draft_model``/``k`` pair adds the draft cache pool and replaces the
+    step loop with the shared draft+verify round at verify width k+1.
+    ``phase`` is ``"init"`` (pools built in-trace), ``"admit"`` (prompt
+    splice + previous-occupant eviction), or ``"plain"`` (steady state).
+    One compile cache, keyed by the full composition, lives on the model
+    (mirrors ``sampler._jit_sampler``).
+
+    graft-lint pins this as the only donated chunk-program jit site in the
+    tree (the ``engine-registry`` AST rule) and audits each composition's
+    compiled module under its registry name: every pool leaf of every
+    composition must alias input->output with no full-pool-shaped copy."""
     import jax
 
     from .sampler import decode_cache_shapes
 
+    spec = draft_model is not None
+    if spec == (k is None):
+        raise ValueError("draft_model and k come together (the spec "
+                         "component is one composable unit)")
+    if phase not in ("init", "admit", "plain"):
+        raise ValueError(f"unknown chunk phase {phase!r}")
+    paged = None if paged is None else (int(paged[0]), int(paged[1]))
     cache = model.__dict__.setdefault("_engine_jit_cache", {})
-    cache_key = (mesh, kind)
+    cache_key = (mesh, phase, id(draft_model) if spec else None,
+                 None if k is None else int(k), paged)
     if cache_key in cache:
         return cache[cache_key]
     import jax.numpy as jnp
 
-    init_caches = kind == "engine_init"
-    admit = kind in ("engine_init", "engine_admit")
+    init_caches = phase == "init"
+    admit = phase in ("init", "admit")
+    kk = 0 if k is None else int(k)
+    if paged is not None:
+        from ..model import decode as decode_mod
+        from .paged import classify_cache_leaves
+        bt, nb = paged
 
-    def step(variables, ipb, tb, end_pos, steps, fargs, admit_args, carry):
+    def build_pool(shapes, info):
+        """Zero pools built INSIDE the donated trace (the engine_init
+        rule): a serving mesh constrains their sharding in-program, and no
+        unusable host-side zero copy ever exists.  Paged leaves land at
+        pool geometry; sequence-recurrent leaves stay resident per slot."""
+        pools = {}
+        for n, s in shapes.items():
+            if paged is None or info[n][1] is None:
+                pools[n] = jnp.zeros(s.shape, s.dtype)
+            else:
+                baxis, sax = info[n]
+                ps = list(s.shape)
+                ps[baxis], ps[sax] = nb, bt
+                pools[n] = jnp.zeros(ps, s.dtype)
+        return pools
+
+    def gather(pools, info, rtable):
+        if paged is None:
+            return pools
+        return {n: (decode_mod.gather_blocks(leaf, rtable, info[n][0],
+                                             info[n][1])
+                    if info[n][1] is not None else leaf)
+                for n, leaf in pools.items()}
+
+    def scatter(pools, views, info, wtable):
+        if paged is None:
+            return views
+        return {n: (decode_mod.scatter_blocks(pools[n], v, wtable,
+                                              info[n][0], info[n][1], bt)
+                    if info[n][1] is not None else v)
+                for n, v in views.items()}
+
+    def clear_views(views, info, mask, keep_len, seq, batch):
+        """Evict the previous occupant from the admitted slots' views:
+        rows at/past the shared length zero (keep_len 0 — no prefix hit —
+        is the slot engine's uniform clear, bit for bit); sequence-
+        recurrent resident leaves clear whole-row, exactly like the plain
+        admit splice."""
+        out = {}
+        for n, v in views.items():
+            baxis, sax = info[n]
+            mshape = [1] * v.ndim
+            mshape[baxis] = batch
+            if sax is None:
+                drop = mask.reshape(mshape)
+            else:
+                pshape = [1] * v.ndim
+                pshape[sax] = seq
+                drop = (mask.reshape(mshape)
+                        & (jnp.arange(seq).reshape(pshape)
+                           >= keep_len.reshape(mshape)))
+            out[n] = jnp.where(drop, jnp.zeros((), v.dtype), v)
+        return out
+
+    def run(variables, dvariables, q, ipb, tb, end_pos, steps, fargs,
+            spec_args, admit_args, rtable, wtable, carry):
         if init_caches:
-            q, token_x, key, seen = carry
-            # pool built INSIDE the donated trace (like kv_step_init): a
-            # serving mesh constrains its sharding in-program, and no
-            # unusable host-side zero copy ever exists
-            caches = {k: jnp.zeros(v.shape, v.dtype) for k, v in
-                      decode_cache_shapes(model, variables, token_x).items()}
+            if spec:
+                token_x, key, seen = carry
+            else:
+                q, token_x, key, seen = carry
+            pools = dpools = None
+        elif spec:
+            token_x, pools, dpools, key, seen = carry
         else:
-            q, token_x, caches, key, seen = carry
+            q, token_x, pools, key, seen = carry
+            dpools = None
+        batch, seq = token_x.shape[0], token_x.shape[1]
+        info = dinfo = None
+        if init_caches or paged is not None:
+            shapes = decode_cache_shapes(model, variables, token_x)
+            if paged is not None:
+                info = classify_cache_leaves(shapes, seq)
+            if spec:
+                dshapes = decode_cache_shapes(draft_model, dvariables,
+                                              token_x)
+                if paged is not None:
+                    dinfo = classify_cache_leaves(dshapes, seq)
+        if init_caches:
+            pools = build_pool(shapes, info)
+            if spec:
+                dpools = build_pool(dshapes, dinfo)
+        views = gather(pools, info, rtable)
+        dviews = gather(dpools, dinfo, rtable) if spec else None
         if admit:
-            mask, new_rows = admit_args
-            q = jnp.where(mask, jnp.zeros_like(q), q)
-            token_x, seen, pools = _splice_admitted(
-                token_x, seen, ipb, mask, new_rows,
-                () if init_caches else (caches,))
-            if not init_caches:
-                caches, = pools
-        return _engine_loop(model, mesh, variables, ipb, tb, end_pos, steps,
-                            fargs, q, token_x, caches, key, seen)
+            if paged is not None:
+                mask, new_rows, keep_len = admit_args
+            else:
+                mask, new_rows = admit_args
+                keep_len = None
+            if not spec:
+                # q rides the carry here (it is host state under spec):
+                # admitted slots restart at the shared length (0 when not
+                # paged — no prefix to resume from)
+                new_q = jnp.zeros_like(q) if keep_len is None \
+                    else keep_len.astype(q.dtype)
+                q = jnp.where(mask, new_q, q)
+            if paged is None:
+                # the shared plain-engine splice clears whole cache rows
+                pools_in = () if init_caches else \
+                    ((views, dviews) if spec else (views,))
+                token_x, seen, out = _splice_admitted(
+                    token_x, seen, ipb, mask, new_rows, pools_in)
+                if not init_caches:
+                    if spec:
+                        views, dviews = out
+                    else:
+                        views, = out
+            else:
+                token_x, seen, _ = _splice_admitted(token_x, seen, ipb,
+                                                    mask, new_rows, ())
+                views = clear_views(views, info, mask, keep_len, seq,
+                                    batch)
+                if spec:
+                    dviews = clear_views(dviews, dinfo, mask, keep_len,
+                                         seq, batch)
+        if spec:
+            spec_mask, fix_tok, fix_mask, seen_lo = spec_args
+            token_x, views, dviews, key, seen, vt = _spec_round(
+                model, draft_model, mesh, kk, variables, dvariables, q,
+                ipb, tb, end_pos, fargs, spec_mask, fix_tok, fix_mask,
+                seen_lo, token_x, views, dviews, key, seen)
+            return (token_x, scatter(pools, views, info, wtable),
+                    scatter(dpools, dviews, dinfo, wtable), key, seen, vt)
+        q, token_x, views, key, seen = _engine_loop(
+            model, mesh, variables, ipb, tb, end_pos, steps, fargs, q,
+            token_x, views, key, seen)
+        return q, token_x, scatter(pools, views, info, wtable), key, seen
 
-    # the carry (argument 7) is DONATED: every cache-pool leaf must alias
-    # input->output — the invariant graft-lint's engine_chunk_step audit
-    # pins on the compiled module (docs/STATIC_ANALYSIS.md)
-    cache[cache_key] = jax.jit(step, donate_argnums=(7,))
+    # four composition-specific signatures (the block tables and the spec
+    # arguments appear only when their component does, so every existing
+    # call convention is preserved), ONE jit call: the carry is always the
+    # LAST argument and always donated — every cache-pool leaf of every
+    # composition must alias input->output (graft-lint audits each
+    # composition's compiled module under its ENGINE_PROGRAMS name)
+    if spec and paged is not None:
+        def step(variables, dvariables, q, ipb, tb, end_pos, fargs,
+                 spec_mask, fix_tok, fix_mask, seen_lo, admit_args, rtable,
+                 wtable, carry):
+            return run(variables, dvariables, q, ipb, tb, end_pos, None,
+                       fargs, (spec_mask, fix_tok, fix_mask, seen_lo),
+                       admit_args, rtable, wtable, carry)
+        donate = 14
+    elif spec:
+        def step(variables, dvariables, q, ipb, tb, end_pos, fargs,
+                 spec_mask, fix_tok, fix_mask, seen_lo, admit_args, carry):
+            return run(variables, dvariables, q, ipb, tb, end_pos, None,
+                       fargs, (spec_mask, fix_tok, fix_mask, seen_lo),
+                       admit_args, None, None, carry)
+        donate = 12
+    elif paged is not None:
+        def step(variables, ipb, tb, end_pos, steps, fargs, admit_args,
+                 rtable, wtable, carry):
+            return run(variables, None, None, ipb, tb, end_pos, steps,
+                       fargs, None, admit_args, rtable, wtable, carry)
+        donate = 9
+    else:
+        def step(variables, ipb, tb, end_pos, steps, fargs, admit_args,
+                 carry):
+            return run(variables, None, None, ipb, tb, end_pos, steps,
+                       fargs, None, admit_args, None, None, carry)
+        donate = 7
+    cache[cache_key] = jax.jit(step, donate_argnums=(donate,))
     return cache[cache_key]
+
+
+class Engine:
+    """ONE serving engine, composed per deployment.
+
+    Owns the mesh, the donation discipline, and the compile cache for the
+    registered chunk programs (:data:`ENGINE_PROGRAMS`): an executor holds
+    an Engine describing WHICH orthogonal carry components its deployment
+    assembles — the draft pool + verify width via ``draft_model``/``k``,
+    the block tables via ``paged=(block_tokens, num_blocks)`` — and
+    fetches each phase's compiled program from it.  Spec-on-paged is a
+    composition handed to the one builder, not a fourth forked program;
+    dropping a component (the speculative self-disable) is recomposition,
+    not a carry-layout migration hand-written per pair.  ``name`` is the
+    registry/audit name ``budgets.json``, ``cost_ledger.json``, and the
+    mesh audit key this composition's rows by."""
+
+    def __init__(self, model: Model, mesh, *,
+                 draft_model: typing.Optional[Model] = None,
+                 k: typing.Optional[int] = None,
+                 paged: typing.Optional[typing.Tuple[int, int]] = None):
+        self.model = model
+        self.mesh = mesh
+        self.draft_model = draft_model
+        self.k = None if k is None else int(k)
+        self.paged = None if paged is None else (int(paged[0]),
+                                                 int(paged[1]))
+        self.name = program_name(spec=draft_model is not None,
+                                 paged=paged is not None)
+
+    @property
+    def components(self) -> typing.Dict[str, bool]:
+        """The composition's registry row (``{"spec": ..., "paged": ...}``)."""
+        return dict(ENGINE_PROGRAMS[self.name])
+
+    def step(self, phase: str):
+        """The composition's compiled donated program for ``phase``
+        (``"init"``/``"admit"``/``"plain"``)."""
+        return _chunk_jit(self.model, self.mesh, phase,
+                          draft_model=self.draft_model, k=self.k,
+                          paged=self.paged)
+
+
+def _engine_jit(model: Model, mesh, kind: str):
+    """Compat shim: the retired ``engine_init``/``engine_admit``/
+    ``engine_plain`` kind names onto the Engine's single builder."""
+    return _chunk_jit(model, mesh, kind.split("_", 1)[1])
 
 
 def _spec_jit(model: Model, draft_model: Model, mesh, kind: str, k: int):
-    """Per-model cache of the jitted SPECULATIVE chunk steps (draft + verify
-    in one donated program; see the module docstring for the round shape).
-    ``k`` is the draft depth (``spec_draft_tokens``), passed explicitly —
-    it shapes the program (verify width k+1) and is part of the cache key.
-    Audited as ``spec_chunk_step`` by graft-lint: every leaf of BOTH cache
-    pools aliases input->output, no full-pool copy."""
-    import jax
-
-    from .sampler import decode_cache_shapes
-
-    cache = model.__dict__.setdefault("_spec_jit_cache", {})
-    cache_key = (mesh, kind, id(draft_model), int(k))
-    if cache_key in cache:
-        return cache[cache_key]
-    import jax.numpy as jnp
-
-    init_caches = kind == "spec_init"
-    admit = kind in ("spec_init", "spec_admit")
-    k = int(k)
-
-    def step(variables, dvariables, q, ipb, tb, end_pos, fargs, spec_mask,
-             fix_tok, fix_mask, seen_lo, admit_args, carry):
-        if init_caches:
-            token_x, key, seen = carry
-            caches = {n: jnp.zeros(v.shape, v.dtype) for n, v in
-                      decode_cache_shapes(model, variables, token_x).items()}
-            dcaches = {n: jnp.zeros(v.shape, v.dtype) for n, v in
-                       decode_cache_shapes(draft_model, dvariables,
-                                           token_x).items()}
-        else:
-            token_x, caches, dcaches, key, seen = carry
-        batch, seq = token_x.shape[0], token_x.shape[1]
-        rows3 = jnp.arange(batch)[:, None, None]
-        if admit:
-            # the shared plain-engine splice, over BOTH pools (q is host
-            # state here — the executor zeroed it at admit staging)
-            mask, new_rows = admit_args
-            token_x, seen, pools = _splice_admitted(
-                token_x, seen, ipb, mask, new_rows,
-                () if init_caches else (caches, dcaches))
-            if not init_caches:
-                caches, dcaches = pools
-        end_pos = jnp.minimum(end_pos, seq)
-        qc = jnp.clip(q, 0, seq - 1)
-        # host accept/reject splice: the previous round's correction (or
-        # bonus) token lands at the row's NEW position q — the token this
-        # round's first draft step and verify offset 0 consume
-        old_q = jnp.take_along_axis(token_x, qc[:, None, None], axis=1)
-        fixed = jnp.where(fix_mask[:, None, None], fix_tok[:, None, :],
-                          old_q)
-        token_x = token_x.at[jnp.arange(batch), qc].set(
-            jnp.squeeze(fixed, 1))
-        # repetition-penalty catch-up for the tokens the previous round
-        # emitted: count positions (seen_lo, q] at/past the prompt boundary
-        # (prompt counts were seeded at admit) so `seen` again reflects the
-        # full context below the write position, the plain-body invariant
-        cm = ((jnp.arange(seq)[None, :, None] > seen_lo[:, None, None])
-              & (jnp.arange(seq)[None, :, None] <= q[:, None, None])
-              & (jnp.arange(seq)[None, :, None] >= ipb[:, None, None])
-              ).astype(jnp.float32)
-        seen = seen.at[rows3, token_x].add(cm)
-        active = q < end_pos - 1
-
-        # ---- draft: k+1 sequential quarter-width steps from each slot's
-        # position; k greedy draft tokens written (slots at depth 0 --
-        # spec_mask false -- consume but never write), the +1 step only
-        # fills the draft KV row at q+k so full acceptance leaves no gap
-        def dbody(i, st):
-            token_x, dcaches = st
-            qd = jnp.clip(q + i, 0, seq - 1)
-            cur = jnp.take_along_axis(token_x, qd[:, None, None], axis=1)
-            with jax.named_scope("draft"):
-                dlogits, dc = draft_model.apply_decode(dvariables, cur, qd,
-                                                       dcaches, mesh=mesh)
-            nxt = jnp.argmax(dlogits.astype(jnp.float32), axis=-1
-                             ).astype(token_x.dtype)
-            qp1 = qd + 1
-            old = jnp.take_along_axis(
-                token_x, jnp.clip(qp1, 0, seq - 1)[:, None, None], axis=1)
-            wr = active & spec_mask & (i < k) & (qp1 >= ipb)
-            new = jnp.where(wr[:, None, None], nxt, old)
-            token_x = token_x.at[jnp.arange(batch), qp1].set(
-                jnp.squeeze(new, 1), mode="drop")
-            return token_x, dc
-
-        token_x, dcaches = jax.lax.fori_loop(0, k + 1, dbody,
-                                             (token_x, dcaches))
-
-        # ---- verify: ONE width-(k+1) full-model step scores positions
-        # q..q+k per slot against the whole KV pool in a single cache read
-        vidx = jnp.clip(q[:, None] + jnp.arange(k + 1), 0, seq - 1)
-        vtok = jnp.take_along_axis(token_x, vidx[:, :, None], axis=1)
-        with jax.named_scope("verify"):
-            logits, caches = model.apply_decode(variables, vtok, qc, caches,
-                                                mesh=mesh)
-        with jax.named_scope("sampling"):
-            vt, key = _sample_logits(logits, seen, tb, fargs, key)
-            vt = vt.astype(token_x.dtype)
-        return token_x, caches, dcaches, key, seen, vt
-
-    # the carry (argument 12) is DONATED: every leaf of BOTH pools must
-    # alias input->output (graft-lint's spec_chunk_step audit); vt is the
-    # only fresh output — a [slots, k+1, patch] token readback
-    cache[cache_key] = jax.jit(step, donate_argnums=(12,))
-    return cache[cache_key]
+    """Compat shim: the retired ``spec_*`` kind names onto the Engine's
+    single builder (the spec composition)."""
+    return _chunk_jit(model, mesh, kind.split("_", 1)[1],
+                      draft_model=draft_model, k=k)
 
 
 class EngineExecutor:
@@ -416,6 +634,9 @@ class EngineExecutor:
         # surface only); seeded so reruns are reproducible
         self._pad_rng = np.random.default_rng(p.data_seed)
         self._jnp = jnp
+        #: the deployment's composition — subclasses recompose with their
+        #: components (draft pool, block tables) after their own setup
+        self.engine = Engine(self.model_w, self.mesh)
 
     # -- slot staging --------------------------------------------------------
 
@@ -458,12 +679,12 @@ class EngineExecutor:
         from the same read-back.  Any exception leaves the donated carry
         unusable — callers must ``reset()`` (the controller does)."""
         jnp = self._jnp
-        kind = ("engine_init" if self._carry is None else
-                "engine_admit" if self._admit_mask.any() else "engine_plain")
-        fn = _engine_jit(self.model_w, self.mesh, kind)
+        phase = ("init" if self._carry is None else
+                 "admit" if self._admit_mask.any() else "plain")
+        fn = self.engine.step(phase)
         fargs = (jnp.asarray(self.top_k), jnp.asarray(self.top_p),
                  jnp.asarray(self.rep))
-        if kind == "engine_init":
+        if phase == "init":
             seen = jnp.zeros((self.slots, self.params_w.vocab_size),
                              jnp.float32)
             carry = (jnp.zeros(self.slots, jnp.int32),
@@ -471,7 +692,7 @@ class EngineExecutor:
         else:
             carry = self._carry
         admit_args = ()
-        if kind != "engine_plain":
+        if phase != "plain":
             admit_args = (jnp.asarray(self._admit_mask),
                           jnp.asarray(self._admit_rows))
         out = fn(self.variables, jnp.asarray(self.ipb), jnp.asarray(self.tb),
@@ -535,6 +756,17 @@ class SpecEngineExecutor(EngineExecutor):
                  seed: typing.Optional[int] = None,
                  draft_tokens: typing.Optional[int] = None,
                  min_accept_rate: typing.Optional[float] = None):
+        super().__init__(interface, slots, seed=seed)
+        self._init_spec(draft, draft_tokens, min_accept_rate)
+
+    def _init_spec(self, draft,
+                   draft_tokens: typing.Optional[int] = None,
+                   min_accept_rate: typing.Optional[float] = None) -> None:
+        """Attach the spec component to an already-built executor: draft
+        pool, host accept state, and the recomposed Engine.  Factored out
+        of ``__init__`` so ``SpecPagedEngineExecutor`` can stack it on top
+        of the paged base — the composition IS the two init halves run in
+        sequence, mirroring the carry."""
         import collections
 
         import jax
@@ -542,7 +774,7 @@ class SpecEngineExecutor(EngineExecutor):
         from . import spec as spec_mod
         from .sampler import decode_cache_shapes
 
-        super().__init__(interface, slots, seed=seed)
+        interface = self.interface
         p: ModelParameter = interface.params
         # knobs ride explicit arguments so the caller's RESOLVED params win
         # (rest_api._resolve_engine serves a params object that may differ
@@ -590,6 +822,11 @@ class SpecEngineExecutor(EngineExecutor):
         # admit/release, and re-uploading all of them every round is
         # measurable host overhead next to a multi-token verify round
         self._dev_args = None
+        # recompose with the draft pool on top of whatever the base built
+        # (plain slots, or the paged component's block tables)
+        self.engine = Engine(self.model_w, self.mesh,
+                             draft_model=self.draft_model_w, k=self.k,
+                             paged=self.engine.paged)
 
     # -- slot staging --------------------------------------------------------
 
@@ -620,10 +857,9 @@ class SpecEngineExecutor(EngineExecutor):
         jnp = self._jnp
         rounds = max(1, -(-int(steps) // (self.k + 1)))
         for _ in range(rounds):
-            kind = ("spec_init" if self._carry is None else
-                    "spec_admit" if self._admit_mask.any() else "spec_plain")
-            fn = _spec_jit(self.model_w, self.draft_model_w, self.mesh, kind,
-                           self.k)
+            phase = ("init" if self._carry is None else
+                     "admit" if self._admit_mask.any() else "plain")
+            fn = self.engine.step(phase)
             if self._dev_args is None:
                 # slot-staging arguments change only at admit/release: keep
                 # their device copies across rounds (the per-round uploads
@@ -636,14 +872,14 @@ class SpecEngineExecutor(EngineExecutor):
                                    jnp.asarray(self.rep)),
                                   jnp.asarray(self._spec_mask))
             ipb_d, tb_d, end_d, fargs, mask_d = self._dev_args
-            if kind == "spec_init":
+            if phase == "init":
                 seen = jnp.zeros((self.slots, self.params_w.vocab_size),
                                  jnp.float32)
                 carry = (jnp.asarray(self._token_host), self._key0, seen)
             else:
                 carry = self._carry
             admit_args = ()
-            if kind != "spec_plain":
+            if phase != "plain":
                 admit_args = (jnp.asarray(self._admit_mask),
                               jnp.asarray(self._admit_rows))
             out = fn(self.variables, self.draft_variables,
@@ -746,11 +982,16 @@ class SpecEngineExecutor(EngineExecutor):
         self._to_plain_carry()
 
     def _to_plain_carry(self) -> None:
-        """Convert the spec carry into the plain engine's donated carry:
-        the host token mirror already holds every emitted token (including
-        corrections the device never saw), so token_x re-uploads from it;
-        ``seen`` gets the same host-side catch-up the next spec round would
-        have applied; the draft pool is dropped (freed)."""
+        """Drop the spec component from the composition: the Engine
+        recomposes without the draft pool (the remaining components — plain
+        slots or block tables — keep their layout), and the carry converts
+        to the recomposed program's shape.  The host token mirror already
+        holds every emitted token (including corrections the device never
+        saw), so token_x re-uploads from it; ``seen`` gets the same
+        host-side catch-up the next spec round would have applied; the
+        draft pool is dropped (freed)."""
+        self.engine = Engine(self.model_w, self.mesh,
+                             paged=self.engine.paged)
         if self._carry is None or len(self._carry) != 5:
             return
         jnp = self._jnp

@@ -60,7 +60,7 @@ import typing
 
 import numpy as np
 
-from .engine import EngineExecutor, _engine_loop, _splice_admitted
+from .engine import Engine, EngineExecutor, SpecEngineExecutor
 
 
 # --------------------------------------------------------------- block pool
@@ -287,94 +287,15 @@ def classify_cache_leaves(shapes: typing.Mapping[str, typing.Any],
 # -------------------------------------------------------- paged chunk step
 
 def _paged_jit(model, mesh, kind: str, block_tokens: int, num_blocks: int):
-    """Per-model cache of the jitted PAGED chunk steps (kinds
-    ``paged_init``/``paged_admit``/``paged_plain``): gather per-slot views
-    from the block pool through the read table, run the SHARED engine loop
-    (``engine._engine_loop`` — the paged-vs-plain parity contract), scatter
-    the views back through the write table.  The carry (pool leaves +
-    q/token_x/key/seen) is donated; graft-lint audits the compiled module
-    as ``paged_chunk_step`` (every pool leaf aliased, no full-pool copy)."""
-    import jax
+    """Compat shim: the retired ``paged_init``/``paged_admit``/
+    ``paged_plain`` kind names onto the Engine's single builder
+    (``engine._chunk_jit`` with the ``paged`` component — the gather /
+    shared-loop / scatter body now lives there, once, for both the paged
+    and the spec-on-paged compositions)."""
+    from .engine import _chunk_jit
 
-    from ..model import decode as decode_mod
-    from .sampler import decode_cache_shapes
-
-    cache = model.__dict__.setdefault("_paged_jit_cache", {})
-    cache_key = (mesh, kind, int(block_tokens), int(num_blocks))
-    if cache_key in cache:
-        return cache[cache_key]
-    import jax.numpy as jnp
-
-    init_caches = kind == "paged_init"
-    admit = kind in ("paged_init", "paged_admit")
-    bt, nb = int(block_tokens), int(num_blocks)
-
-    def step(variables, ipb, tb, end_pos, steps, fargs, admit_args, rtable,
-             wtable, carry):
-        if init_caches:
-            q, token_x, key, seen = carry
-        else:
-            q, token_x, pools, key, seen = carry
-        batch, seq = token_x.shape[0], token_x.shape[1]
-        shapes = decode_cache_shapes(model, variables, token_x)
-        info = classify_cache_leaves(shapes, seq)
-        if init_caches:
-            # pools built INSIDE the donated trace (the engine_init rule):
-            # a serving mesh constrains their sharding in-program, and no
-            # unusable host-side zero copy ever exists
-            pools = {}
-            for n, s in shapes.items():
-                baxis, sax = info[n]
-                if sax is None:
-                    pools[n] = jnp.zeros(s.shape, s.dtype)
-                else:
-                    ps = list(s.shape)
-                    ps[baxis], ps[sax] = nb, bt
-                    pools[n] = jnp.zeros(ps, s.dtype)
-        views = {}
-        for n, leaf in pools.items():
-            baxis, sax = info[n]
-            views[n] = (decode_mod.gather_blocks(leaf, rtable, baxis, sax)
-                        if sax is not None else leaf)
-        if admit:
-            mask, new_rows, keep_len = admit_args
-            q = jnp.where(mask, keep_len.astype(q.dtype), q)
-            token_x, seen, _ = _splice_admitted(token_x, seen, ipb, mask,
-                                                new_rows, ())
-            # evict the previous occupant from the admitted slots' views:
-            # rows at/past the shared length zero (keep_len 0 — no prefix
-            # hit — is the slot engine's uniform clear, bit for bit);
-            # sequence-recurrent resident leaves clear whole-row, exactly
-            # like the plain admit splice
-            for n, v in views.items():
-                baxis, sax = info[n]
-                mshape = [1] * v.ndim
-                mshape[baxis] = batch
-                if sax is None:
-                    drop = mask.reshape(mshape)
-                else:
-                    pshape = [1] * v.ndim
-                    pshape[sax] = seq
-                    drop = (mask.reshape(mshape)
-                            & (jnp.arange(seq).reshape(pshape)
-                               >= keep_len.reshape(mshape)))
-                views[n] = jnp.where(drop, jnp.zeros((), v.dtype), v)
-        q, token_x, views, key, seen = _engine_loop(
-            model, mesh, variables, ipb, tb, end_pos, steps, fargs, q,
-            token_x, views, key, seen)
-        out_pools = {}
-        for n, leaf in pools.items():
-            baxis, sax = info[n]
-            out_pools[n] = (decode_mod.scatter_blocks(leaf, views[n], wtable,
-                                                      baxis, sax, bt)
-                            if sax is not None else views[n])
-        return q, token_x, out_pools, key, seen
-
-    # the carry (argument 9) is DONATED: every pool leaf (and resident
-    # recurrent leaf) must alias input->output — graft-lint's
-    # paged_chunk_step audit pins it on the compiled module
-    cache[cache_key] = jax.jit(step, donate_argnums=(9,))
-    return cache[cache_key]
+    return _chunk_jit(model, mesh, kind.split("_", 1)[1],
+                      paged=(int(block_tokens), int(num_blocks)))
 
 
 # ------------------------------------------------------------- the executor
@@ -455,6 +376,9 @@ class PagedEngineExecutor(EngineExecutor):
             _, sax = self.leaf_info[n]
             self.cache_bytes += int(bytes_ * ratio) if sax is not None \
                 else bytes_
+        # recompose with the block tables on top of the plain slots
+        self.engine = Engine(self.model_w, self.mesh,
+                             paged=(self.block_tokens, self.num_blocks))
 
     # -- block bookkeeping ---------------------------------------------------
 
@@ -614,13 +538,12 @@ class PagedEngineExecutor(EngineExecutor):
     def dispatch(self, steps: int) -> np.ndarray:
         jnp = self._jnp
         self._ensure_blocks(steps)
-        kind = ("paged_init" if self._carry is None else
-                "paged_admit" if self._admit_mask.any() else "paged_plain")
-        fn = _paged_jit(self.model_w, self.mesh, kind, self.block_tokens,
-                        self.num_blocks)
+        phase = ("init" if self._carry is None else
+                 "admit" if self._admit_mask.any() else "plain")
+        fn = self.engine.step(phase)
         fargs = (jnp.asarray(self.top_k), jnp.asarray(self.top_p),
                  jnp.asarray(self.rep))
-        if kind == "paged_init":
+        if phase == "init":
             seen = jnp.zeros((self.slots, self.params_w.vocab_size),
                              jnp.float32)
             carry = (jnp.zeros(self.slots, jnp.int32),
@@ -628,7 +551,7 @@ class PagedEngineExecutor(EngineExecutor):
         else:
             carry = self._carry
         admit_args = ()
-        if kind != "paged_plain":
+        if phase != "plain":
             admit_args = (jnp.asarray(self._admit_mask),
                           jnp.asarray(self._admit_rows),
                           jnp.asarray(self._keep_len))
@@ -683,3 +606,106 @@ class PagedEngineExecutor(EngineExecutor):
             "sharing": self.sharing,
             **self.stats,
         }
+
+
+# ------------------------------------------------- the composed deployment
+
+class SpecPagedEngineExecutor(SpecEngineExecutor, PagedEngineExecutor):
+    """Spec-on-paged: draft-and-verify running over the block pool — the
+    ``spec_paged_chunk_step`` composition, assembled from the two
+    components rather than written as a fourth program.
+
+    The draft model's cache leaves page onto the SAME block tables as the
+    target's (one logical block space, two physical pools): a draft KV row
+    is deterministic in tokens+position exactly like a target row, so a
+    prefix-hit admission resumes the draft from the shared span too, COW
+    divergence copies both pools through the same gather/scatter
+    round-trip, and rejected draft rows in both pools self-heal
+    left-to-right before the next round reads them (the rollback-by-
+    overwrite argument, unchanged).  Because the spec probe already refuses
+    sequence-recurrent caches (both models), every leaf of both pools is
+    pageable — the composed deployment always has prefix sharing.
+
+    Construction raises ``NotImplementedError`` on either component's
+    refusal signal (draft geometry, recurrent caches, block divisibility)
+    so ``auto`` knobs can fall back component-wise; greedy parity with the
+    plain slot engine through prefix-hit admission, mid-draft COW
+    divergence, and total-rejection rounds is pinned token-for-token by
+    tests/spec_paged_test.py."""
+
+    def __init__(self, interface, slots: int, draft,
+                 seed: typing.Optional[int] = None,
+                 draft_tokens: typing.Optional[int] = None,
+                 min_accept_rate: typing.Optional[float] = None,
+                 block_tokens: typing.Optional[int] = None,
+                 pool_blocks: typing.Optional[int] = None):
+        # the two init halves run in sequence, mirroring the carry: the
+        # paged base builds pool/tree/tables (and recomposes the Engine
+        # with the block tables), then the spec half stacks the draft pool
+        # + accept state on top and recomposes again
+        PagedEngineExecutor.__init__(self, interface, slots, seed=seed,
+                                     block_tokens=block_tokens,
+                                     pool_blocks=pool_blocks)
+        self._init_spec(draft, draft_tokens, min_accept_rate)
+
+    def dispatch(self, steps: int) -> np.ndarray:
+        """Acceptance-aware dispatch over the block pool: verify rounds
+        like the spec executor, block-table maintenance like the paged one.
+        Once self-disabled, ``_to_plain_carry`` has recomposed the Engine
+        down to the paged composition and every dispatch delegates there."""
+        if not self._spec_enabled:
+            return PagedEngineExecutor.dispatch(self, steps)
+        jnp = self._jnp
+        rounds = max(1, -(-int(steps) // (self.k + 1)))
+        for _ in range(rounds):
+            # a verify round writes at most k+1 rows past each slot's
+            # position: map private blocks through that extent first
+            self._ensure_blocks(self.k + 1)
+            phase = ("init" if self._carry is None else
+                     "admit" if self._admit_mask.any() else "plain")
+            fn = self.engine.step(phase)
+            if self._dev_args is None:
+                self._dev_args = (jnp.asarray(self.ipb),
+                                  jnp.asarray(self.tb),
+                                  jnp.asarray(self.end_pos),
+                                  (jnp.asarray(self.top_k),
+                                   jnp.asarray(self.top_p),
+                                   jnp.asarray(self.rep)),
+                                  jnp.asarray(self._spec_mask))
+            ipb_d, tb_d, end_d, fargs, mask_d = self._dev_args
+            if phase == "init":
+                seen = jnp.zeros((self.slots, self.params_w.vocab_size),
+                                 jnp.float32)
+                carry = (jnp.asarray(self._token_host), self._key0, seen)
+            else:
+                carry = self._carry
+            admit_args = ()
+            if phase != "plain":
+                admit_args = (jnp.asarray(self._admit_mask),
+                              jnp.asarray(self._admit_rows),
+                              jnp.asarray(self._keep_len))
+            out = fn(self.variables, self.draft_variables,
+                     jnp.asarray(self.q.astype(np.int32)),
+                     ipb_d, tb_d, end_d, fargs, mask_d,
+                     jnp.asarray(self._fix_tok),
+                     jnp.asarray(self._fix_mask),
+                     jnp.asarray(self._seen_lo), admit_args,
+                     jnp.asarray(self.rtable), jnp.asarray(self.wtable),
+                     carry)
+            self._carry = out[:5]
+            # np.array, not asarray: the accept loop WRITES corrections
+            self._token_host = np.array(out[0])
+            self._admit_mask[:] = False
+            # the write-back landed: read every written block from its
+            # private copy from now on (completes COW for BOTH pools —
+            # they share the tables)
+            written = self.wtable != self.SENTINEL
+            self.rtable[written] = self.wtable[written]
+            self._accept_round(np.asarray(out[5]))
+            self._promote_prompt_blocks()
+            if not self._spec_enabled:
+                break  # recomposed to paged mid-dispatch: it takes over
+            if not np.any((self.end_pos > 0)
+                          & (self.q < self.end_pos - 1)):
+                break  # every live slot reached its end
+        return self.q

@@ -1105,15 +1105,36 @@ def _resolve_engine(params: ModelParameter, interface):
                 "\"auto\"/\"continuous\" or spec_decode to \"off\"/\"auto\"")
         return None
     slots = max(1, int(getattr(params, "serve_slots", 8) or 1))
-    if paging != "off" and spec_mode == "draft":
-        # both knobs demand their own chunk program and the paged spec
-        # composition does not exist yet — refuse the contradiction loudly
-        # instead of silently dropping one of two explicit requirements
-        raise RuntimeError(
-            "kv_paging and spec_decode=\"draft\" cannot be combined yet — "
-            "the speculative engine runs on the fixed-slot pool; set one "
-            "of the two knobs to \"off\"/\"auto\"")
-    if paging != "off":
+    if paging != "off" and spec_mode != "off":
+        # the composed deployment (the Engine's "spec_paged_chunk_step"
+        # composition): draft-and-verify running over the block pool, one
+        # program assembled from the two components.  Fallback is
+        # component-wise: a refusal drops into the single-component
+        # branches below ordered by which knob is HARD ("on"/"draft" —
+        # that component must survive); with both knobs hard any failure
+        # is fatal, never a silent drop of an explicit requirement
+        try:
+            from . import spec as spec_mod
+            from .paged import SpecPagedEngineExecutor
+            draft = getattr(interface, "draft", None)
+            if draft is None:
+                draft = spec_mod.load_draft(params)
+            return SpecPagedEngineExecutor(
+                interface, slots, draft,
+                draft_tokens=int(getattr(params, "spec_draft_tokens", 4)),
+                min_accept_rate=float(getattr(params,
+                                              "spec_min_accept_rate", 0.0)),
+                block_tokens=int(getattr(params, "kv_block_tokens", 16)),
+                pool_blocks=int(getattr(params, "kv_pool_blocks", 0) or 0))
+        except Exception as e:
+            if paging == "on" and spec_mode == "draft":
+                raise RuntimeError(
+                    "kv_paging=\"on\" and spec_decode=\"draft\" but the "
+                    "composed spec-on-paged engine cannot serve this "
+                    f"deployment: {e!r}") from e
+            print(f"composed spec-on-paged unavailable ({e!r}); falling "
+                  "back component-wise")
+    if paging != "off" and spec_mode != "draft":
         from .paged import PagedEngineExecutor
         try:
             # NotImplementedError is the ONE auto-fallback signal (geometry
@@ -1133,9 +1154,9 @@ def _resolve_engine(params: ModelParameter, interface):
                   "continuous engine")
         else:
             if spec_mode != "off":
-                print("kv_paging engaged; spec_decode=auto is skipped "
-                      "(the speculative engine runs on the fixed-slot "
-                      "pool)")
+                print("kv_paging engaged without speculation; "
+                      "spec_decode=auto is skipped (the composed "
+                      "spec-on-paged attempt above refused)")
             return executor
     if spec_mode != "off":
         # speculative decoding rides the continuous engine: build the draft
@@ -1552,6 +1573,10 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
             answer=answer, hooks=hooks)
     engine_info = {"mode": "continuous" if controller else "batch",
                    "slots": executor.slots if executor else 0}
+    if executor is not None:
+        # which ENGINE_PROGRAMS composition this deployment assembled —
+        # the same registry name the HLO/mesh audits and budgets key by
+        engine_info["program"] = executor.engine.name
     if hasattr(executor, "spec_summary"):
         # speculative engine: surface the acceptance economics on /health
         # (the live rate rides /metrics; this is the startup config view)

@@ -350,9 +350,14 @@ class EngineController:
         # resident is the scheduler's LIVE dict (slot -> (req, admitted)),
         # not a copy: only the request tracer consumes it, and building a
         # per-chunk list would tax every untraced deployment's hot loop
+        # program: the ENGINE_PROGRAMS registry name of the composition
+        # that served this chunk (compositions can change live — the spec
+        # self-disable recomposes the Engine without the draft pool)
+        engine = getattr(self.executor, "engine", None)
         self.hooks("chunk", dt=dt, steps=advanced, generated=generated,
                    cache_bytes=getattr(self.executor, "cache_bytes", 0),
-                   phase=self.last_phase, resident=self.sched.resident)
+                   phase=self.last_phase, resident=self.sched.resident,
+                   program=getattr(engine, "name", None))
         # paged executor: per-chunk block-pool occupancy + sharing stats
         # flow through the same hook seam (rest_api exports the hbnlp_kv_*
         # gauges from them; the scheduler stays engine-flavor-agnostic)

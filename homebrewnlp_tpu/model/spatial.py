@@ -198,6 +198,108 @@ def _masked_map(args: BlockArgs) -> typing.Tuple[NamedTensor, typing.Union[Named
                   if is_masked(args) else 1)
 
 
+_MAP_MIXER_FALLBACK_SEEN: typing.Set[str] = set()
+
+
+def _map_mixer_declined(reason: str) -> None:
+    """Loud, once per reason per process: the learned-map mixer expected the
+    pallas blocked kernel (the default at supported shapes) but is taking
+    the dense einsum."""
+    if reason not in _MAP_MIXER_FALLBACK_SEEN:
+        _MAP_MIXER_FALLBACK_SEEN.add(reason)
+        print(f"map-mixer kernel fallback: {reason}; using the dense einsum",
+              flush=True)
+
+
+def _maybe_map_mixer(args: BlockArgs, dim: Dim, bias: NamedTensor,
+                     mask: typing.Union[NamedTensor, int],
+                     base: typing.Optional[BlockArgs]
+                     ) -> typing.Optional[NamedTensor]:
+    """Route the PURE learned-map mixer (biased_attention_map without
+    dot_product/softmax: out = (bias·mask) @ value) through the pallas
+    blocked kernel (parallel/map_mixer.py) — the flagship mixer's hot op.
+    Returns None to fall back to the dense einsum; unsupported-shape
+    declines are loud (``_map_mixer_declined``), semantically-different
+    flag combinations (a second dense map) fall through silently.
+
+    Same gate discipline as the flash route: every decline happens BEFORE
+    value extraction, which consumes scoped parameter counters (and, under
+    prefill, kv-cache name counters) exactly once on the taken path."""
+    from ..core import scope as scope_mod
+    from ..core.tensor import nt, transpose_to
+    params = args.params
+    if not params.use_map_mixer_kernel:
+        return None
+    if "scale_attention_map" in args.name_extras:
+        return None  # a second dense map multiplies the output elementwise
+    ctx = scope_mod.current()
+    if ctx.decode is not None:
+        _map_mixer_declined("incremental decode uses the kv-cache dense "
+                            "path")
+        return None
+    if decode_mod.is_prefill_dim(decode_mod.prefill_active(), dim):
+        _map_mixer_declined("prefill keeps the dense path (bit-parity with "
+                            "the decode steps that continue its caches)")
+        return None
+    if params.head_dim not in args.tensor.dims \
+            or params.key_dim not in args.tensor.dims:
+        _map_mixer_declined("mixer tensor lacks the head/feature dims")
+        return None
+    tmp = _key_dim(dim)
+    if dim.size != tmp.size or dim.size % 128:
+        _map_mixer_declined(
+            f"map is [{dim.size}, {tmp.size}] — kernel tiles need a square "
+            "map on a 128-multiple sequence")
+        return None
+    mesh = ctx.mesh
+    if mesh is not None and (mesh.shape.get(shardlib.SEQUENCE_AXIS, 1) > 1
+                             or mesh.shape.get(shardlib.PIPE_AXIS, 1) > 1):
+        _map_mixer_declined("sequence-/pipe-sharded meshes keep the dense "
+                            "path (the learned map is not ring-decomposed)")
+        return None
+    lead = 1
+    for d in args.tensor.dims:
+        if d not in (dim, params.head_dim, params.key_dim):
+            lead *= d.size
+    if mesh is not None and (
+            lead % max(1, mesh.shape.get(shardlib.DATA_AXIS, 1))
+            or params.head_dim.size
+            % max(1, mesh.shape.get(shardlib.MODEL_AXIS, 1))):
+        _map_mixer_declined("lead/head dims not divisible by the data/model "
+                            "mesh axes")
+        return None
+    val = (args.tensor if "input_as_value" in args.name_extras
+           else activated_linear_out(base))
+    canonical = [d for d in args.tensor.dims
+                 if d not in (dim, params.head_dim, params.key_dim)] \
+        + [dim, params.head_dim, params.key_dim]
+    v4 = transpose_to(val + 0 * args.tensor, canonical)
+    shp = (lead, dim.size, params.head_dim.size, params.key_dim.size)
+    v_arr = v4.data.reshape(shp)
+    bias_arr = transpose_to(bias, [params.head_dim, dim, tmp]).data
+    causal = isinstance(mask, NamedTensor)
+    from ..parallel.map_mixer import mix
+
+    if mesh is None:
+        out = mix(bias_arr, v_arr, causal=causal)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.compat import shard_map
+        spec_v = P(shardlib.DATA_AXIS if shardlib.DATA_AXIS
+                   in mesh.axis_names else None, None,
+                   shardlib.MODEL_AXIS if shardlib.MODEL_AXIS
+                   in mesh.axis_names else None, None)
+        spec_b = P(shardlib.MODEL_AXIS if shardlib.MODEL_AXIS
+                   in mesh.axis_names else None, None, None)
+        out = shard_map(
+            lambda b_, v_: mix(b_, v_, causal=causal),
+            mesh=mesh, in_specs=(spec_b, spec_v), out_specs=spec_v,
+            check_vma=False)(bias_arr, v_arr)
+    out_nt = nt(out.reshape([d.size for d in canonical]), canonical)
+    return transpose_to(out_nt, args.tensor.dims)
+
+
 def cumsum(args: BlockArgs) -> NamedTensor:
     dim = get_attention_dim(args).dim
     state = decode_mod.active()
@@ -267,7 +369,12 @@ def attention(args: BlockArgs) -> NamedTensor:
         logit = exp(logit)
         logit = logit / reduce_sum(logit, reduced_dim=tmp)
     if "biased_attention_map" in args.name_extras:
-        logit = logit + multiply(*_masked_map(args))
+        bias, mask = _masked_map(args)
+        if not isinstance(logit, NamedTensor) and not isinstance(val, NamedTensor):
+            mixed = _maybe_map_mixer(args, dim, bias, mask, base)
+            if mixed is not None:
+                return mixed
+        logit = logit + multiply(bias, mask)
     if "scale_attention_map" in args.name_extras:
         logit = logit * multiply(*_masked_map(args))
     if not isinstance(val, NamedTensor):

@@ -275,9 +275,10 @@ def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
 
     # "spec" is the continuous engine with draft-and-verify required (the
     # caller attaches interface.draft); "paged" is the continuous engine on
-    # the KV block pool with kv_paging required; any construction failure
-    # must fail the A/B loudly, not silently measure the plain engine
-    serve_engine = ("continuous" if engine in ("spec", "paged")
+    # the KV block pool with kv_paging required; "spec_paged" composes BOTH
+    # components (the Engine's spec_paged_chunk_step); any construction
+    # failure must fail the A/B loudly, not silently measure a lesser engine
+    serve_engine = ("continuous" if engine in ("spec", "paged", "spec_paged")
                     else engine)
     trace_over = {}
     if trace_dir:
@@ -288,9 +289,12 @@ def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
     params = ModelParameter(interface.params,
                             serve_engine=serve_engine, serve_slots=slots,
                             serve_batch_size=batch,
-                            kv_paging="on" if engine == "paged" else "off",
+                            kv_paging="on" if engine in ("paged",
+                                                         "spec_paged")
+                            else "off",
                             kv_block_tokens=block_tokens,
-                            spec_decode="draft" if engine == "spec"
+                            spec_decode="draft" if engine in ("spec",
+                                                              "spec_paged")
                             else "off",
                             spec_draft_tokens=spec_k, **trace_over)
     params.train = False
@@ -754,6 +758,160 @@ def run_shared_prefix(args) -> dict:
         t.join(timeout=30)
 
 
+# ---- composed spec-on-paged (--spec-paged; docs/SERVING.md 'Engine
+# architecture') --------------------------------------------------------------
+#
+# The Engine's composition headline: spec-decode and paged KV were measured
+# separately (the `spec` and `shared_prefix` rows) but refused to compose
+# until the chunk-program registry made the carry composable
+# (`spec_paged_chunk_step`).  This mode proves the win is MULTIPLICATIVE in
+# ONE deployment: against the PLAIN continuous engine, the composed engine
+# must deliver the draft-and-verify closed-loop tokens/sec speedup AND the
+# prefix-hit TTFT collapse, while staying greedy-bit-identical.  Both the
+# throughput window and the TTFT probes run against the SAME serving
+# process — no per-feature deployments.
+
+SPEC_PAGED_BLOCK_TOKENS = 8     # paging granularity (divides seq 96)
+SPEC_PAGED_SYS_TOKENS = 64      # shared system-prompt length (8 full blocks)
+SPEC_PAGED_TRIALS = 3
+SPEC_PAGED_HITS_PER_TRIAL = 3
+
+
+def _orbit_sysprompt(orbit, trial: int):
+    """A shared system prompt ON the permutation manifold (an orbit walk
+    from a per-trial start), so the composed deployment drafts at the
+    trained pair's acceptance rate while the radix cache serves the shared
+    span.  Distinct starts guarantee distinct first blocks (the radix key
+    is the token sequence from the root), so each trial's first probe is
+    genuinely cold."""
+    toks = [(11 * trial + 5) % len(orbit)]
+    for _ in range(SPEC_PAGED_SYS_TOKENS - 1):
+        toks.append(int(orbit[toks[-1]]))
+    return toks
+
+
+def run_spec_paged(args) -> dict:
+    import numpy as np
+    interface, draft, align = _build_spec_pair()
+    orbit = _spec_perm()
+    canary_payload, _ = _request_for(np.random.default_rng(1234), 3,
+                                     orbit=orbit)
+
+    def warm_and_canary(port):
+        warm_rng = np.random.default_rng(7)
+        for i in range(max(2, args.slots)):
+            payload, _ = _request_for(warm_rng, i, orbit=orbit)
+            _post(port, payload)
+        status, body = _post(port, canary_payload)
+        assert status == 200, body
+        return body
+
+    # phase A: the PLAIN continuous engine — the baseline BOTH composed
+    # components must beat together (draft detached so nothing drafts)
+    interface.draft = None
+    port, stop, t = _spawn(interface, "continuous", args.slots, args.batch)
+    try:
+        _wait_up(port)
+        plain_canary = warm_and_canary(port)
+        rng = np.random.default_rng(args.seed)
+        plain_stats, plain_wall = _closed_loop(
+            port, rng, args.concurrency, args.requests, orbit=orbit)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+
+    # phase B: the composed spec_paged_chunk_step deployment
+    interface.draft = draft
+    port, stop, t = _spawn(interface, "spec_paged", args.slots, args.batch,
+                           spec_k=args.spec_k,
+                           block_tokens=SPEC_PAGED_BLOCK_TOKENS)
+    try:
+        health = _wait_up(port)
+        einfo = health.get("engine") or {}
+        # the composed deployment must BE the composed program — a
+        # component-wise fallback here would silently measure a lesser
+        # engine and void the row
+        assert einfo.get("program") == "spec_paged_chunk_step", health
+        assert (einfo.get("spec") or {}).get("enabled"), health
+        assert (einfo.get("paging") or {}).get("blocks_total"), health
+        comp_canary = warm_and_canary(port)
+        time.sleep(1.5)  # device-loop snapshot publish
+        spec_before = _scrape_spec(port)
+        rng = np.random.default_rng(args.seed)
+        comp_stats, comp_wall = _closed_loop(
+            port, rng, args.concurrency, args.requests, orbit=orbit)
+        # prefix-hit vs cold TTFT in the SAME deployment: a fresh shared
+        # system prompt is cold; tails diverging off it hit its promoted
+        # blocks.  Closed-loop prompts (2-6 tokens) never fill a block, so
+        # they cannot pre-warm the probes.
+        colds, hits = [], []
+        for trial in range(SPEC_PAGED_TRIALS):
+            sysp = _orbit_sysprompt(orbit, trial)
+            nxt = int(orbit[sysp[-1]])   # the on-manifold next symbol
+            dt, status, _ = _timed_post(
+                port, {"tokens": sysp + [(nxt + 11) % len(orbit)],
+                       "max_tokens": 1, "temperature": 0.0})
+            assert status == 200
+            colds.append(dt)
+            for j in range(SPEC_PAGED_HITS_PER_TRIAL):
+                dt, status, _ = _timed_post(
+                    port, {"tokens": sysp + [(nxt + 1 + j) % len(orbit)],
+                           "max_tokens": 1, "temperature": 0.0})
+                assert status == 200
+                hits.append(dt)
+        time.sleep(1.5)  # device-loop snapshot publish
+        spec_after = _scrape_spec(port)
+        kv = _scrape_values(port, (
+            "hbnlp_kv_blocks_total", "hbnlp_kv_prefix_hit_tokens_total",
+            "hbnlp_kv_prefix_hits_total"))
+    finally:
+        stop.set()
+        t.join(timeout=30)
+
+    plain_tps = plain_stats.generated / max(plain_wall, 1e-9)
+    comp_tps = comp_stats.generated / max(comp_wall, 1e-9)
+    drafted = spec_after["drafted"] - spec_before["drafted"]
+    accepted = spec_after["accepted"] - spec_before["accepted"]
+    cold_med = sorted(colds)[len(colds) // 2]
+    hit_med = sorted(hits)[len(hits) // 2]
+    return {
+        "mode": "spec_paged",
+        "program": "spec_paged_chunk_step",
+        "alignment": align,
+        "spec_k": args.spec_k,
+        "block_tokens": SPEC_PAGED_BLOCK_TOKENS,
+        "sys_tokens": SPEC_PAGED_SYS_TOKENS,
+        "canary_parity": (plain_canary.get("tokens")
+                          == comp_canary.get("tokens")),
+        "plain": {
+            "requests_ok": plain_stats.ok, "errors": plain_stats.errors,
+            "generated_tokens": plain_stats.generated,
+            "wall_s": round(plain_wall, 3),
+            "tokens_per_sec": round(plain_tps, 2),
+        },
+        "composed": {
+            "requests_ok": comp_stats.ok, "errors": comp_stats.errors,
+            "generated_tokens": comp_stats.generated,
+            "wall_s": round(comp_wall, 3),
+            "tokens_per_sec": round(comp_tps, 2),
+        },
+        "tokens_per_sec_speedup": round(comp_tps / max(plain_tps, 1e-9), 3),
+        "spec": {
+            "drafted": int(drafted), "accepted": int(accepted),
+            "accept_rate": round(accepted / max(drafted, 1.0), 4),
+            "state": spec_after["state"],
+        },
+        "cold_ttft_s": [round(v, 4) for v in colds],
+        "hit_ttft_s": [round(v, 4) for v in hits],
+        "cold_ttft_median_s": round(cold_med, 4),
+        "hit_ttft_median_s": round(hit_med, 4),
+        "hit_over_cold": round(hit_med / max(cold_med, 1e-9), 4),
+        "prefix_hit_tokens": int(kv["hbnlp_kv_prefix_hit_tokens_total"]),
+        "prefix_hits": int(kv["hbnlp_kv_prefix_hits_total"]),
+        "blocks_total": int(kv["hbnlp_kv_blocks_total"]),
+    }
+
+
 # ---- multi-replica tier (--replicas N; docs/SERVING.md) ---------------------
 #
 # Aggregate tokens/sec should scale ~linearly in replicas.  This rig has
@@ -1013,6 +1171,13 @@ def main(argv=None) -> int:
                          "prompt + divergent tails; records prefix-hit vs "
                          "cold TTFT, greedy parity vs the plain engine, "
                          "and block occupancy (docs/SERVING.md 'Paged KV')")
+    ap.add_argument("--spec-paged", action="store_true", dest="spec_paged",
+                    help="composed spec-on-paged deployment "
+                         "(spec_paged_chunk_step) vs the plain continuous "
+                         "engine: closed-loop draft-and-verify speedup AND "
+                         "prefix-hit vs cold TTFT in the SAME serving "
+                         "process, at greedy bit-parity (docs/SERVING.md "
+                         "'Engine architecture')")
     ap.add_argument("--replicas", type=int, default=0,
                     help="multi-replica tier scaling sweep up to N "
                          "replicas behind the router (device-wait "
@@ -1035,7 +1200,9 @@ def main(argv=None) -> int:
                     help="exit nonzero unless continuous >= 1.5x batch "
                          "closed-loop tokens/sec AND lower p99 TTFT; with "
                          "--spec: spec >= 1.5x continuous at greedy "
-                         "bit-parity (identical canary tokens)")
+                         "bit-parity (identical canary tokens); with "
+                         "--spec-paged: composed >= 1.5x plain AND "
+                         "prefix-hit TTFT <= 0.5x cold AND parity")
     args = ap.parse_args(argv)
     args.batch = args.batch or args.slots
 
@@ -1074,6 +1241,34 @@ def main(argv=None) -> int:
                                 f"tokens: {occ}")
             if result["prefix_hit_tokens"] <= 0:
                 failures.append("no prefix hits recorded")
+        if failures:
+            print("CHECK FAILED: " + "; ".join(failures), flush=True)
+            return 1
+        return 0
+
+    if args.spec_paged:
+        result = run_spec_paged(args)
+        merge_out("spec_paged", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k not in ("cold_ttft_s", "hit_ttft_s")}),
+              flush=True)
+        failures = []
+        if args.check:
+            if not result["canary_parity"]:
+                failures.append("composed canary diverged from the plain "
+                                "continuous engine")
+            if result["tokens_per_sec_speedup"] < 1.5:
+                failures.append(
+                    f"composed speedup {result['tokens_per_sec_speedup']} "
+                    "< 1.5x plain continuous")
+            if result["hit_over_cold"] > 0.5:
+                failures.append(
+                    f"prefix-hit TTFT {result['hit_ttft_median_s']}s is "
+                    f"not <= 0.5x cold {result['cold_ttft_median_s']}s")
+            if result["prefix_hit_tokens"] <= 0:
+                failures.append("no prefix hits recorded")
+            if result["spec"]["drafted"] <= 0:
+                failures.append("no draft tokens recorded")
         if failures:
             print("CHECK FAILED: " + "; ".join(failures), flush=True)
             return 1
@@ -1157,7 +1352,8 @@ def main(argv=None) -> int:
         # drop the nested spec/shared_prefix/replicas rows other modes
         # merged in earlier
         extra = {k: payload[k] for k in ("spec", "shared_prefix",
-                                         "replicas") if k in payload}
+                                         "spec_paged", "replicas")
+                 if k in payload}
         payload = {**result, **extra}
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=1)

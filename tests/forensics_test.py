@@ -735,11 +735,19 @@ def forensics_fleet_sigkill_e2e_test(tmp_path):
         last = (rcs, outs, model_path, survivors)
         if all(rcs[i] == 144 for i in survivors):
             break
-        if attempt == 0 and any(rcs[i] in (-6, 134) for i in survivors):
-            print(f"FLEET RETRY: survivor rcs={rcs} — gloo SIGABRT before "
-                  "the lease scan fired (1-core starvation); retrying "
-                  "with a fresh run dir", flush=True)
-            continue
+        if attempt == 0:
+            # Same classified guard as multihost_test._spawn_workers: this
+            # site needs its own spawn loop (mid-flight SIGKILL timing), so
+            # it shares the classifier rather than the spawner — the reason
+            # stamped here is the same line every fleet retry logs.
+            from multihost_test import starvation_retry_reason
+            reason = starvation_retry_reason(
+                [rcs[i] for i in survivors], [outs[i] for i in survivors])
+            if reason:
+                print(f"FLEET RETRY: {reason} — gloo SIGABRT before the "
+                      "lease scan fired; retrying with a fresh run dir",
+                      flush=True)
+                continue
         break
     rcs, outs, model_path, survivors = last
     assert all(rcs[i] == 144 for i in survivors), \

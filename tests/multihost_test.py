@@ -22,6 +22,28 @@ def _free_port() -> int:
 
 
 
+STARVATION_RCS = (-6, 134)  # gloo SIGABRT: 'another task died'
+
+
+def starvation_retry_reason(rcs, outs=()):
+    """Classify a failed fleet attempt: returns the retry-reason line to
+    stamp into the test log when the exit-code shape matches the known
+    1-core scheduler-starvation flake (the coordination-service heartbeat
+    starves, so gloo SIGABRTs the fleet with 'another task died'), else
+    None — an unclassified failure is a real regression and the caller
+    decides whether to retry.  Shared by _spawn_workers and the direct
+    fleet call sites that need their own spawn loop (forensics_test's
+    SIGKILL e2e) so the retry policy and its logging cannot drift between
+    copies."""
+    if not any(rc in STARVATION_RCS for rc in rcs):
+        return None
+    marker = any("another task died" in (o or "") for o in outs)
+    return (f"worker rcs={rcs} — heartbeat starvation (SIGABRT -6 = "
+            "'another task died'"
+            + ("; marker seen in worker output" if marker else "")
+            + "; 1-core scheduler contention, not product behavior)")
+
+
 def _spawn_workers(worker: str, extra_args, env_devcount: int = 4,
                    n_procs: int = 2, timeout: int = 420, retries: int = 1):
     """Launch n multi-controller worker processes on a shared coordinator
@@ -68,11 +90,15 @@ def _spawn_workers(worker: str, extra_args, env_devcount: int = 4,
         last = results
         if attempt < retries:
             rcs = [p.returncode for p, _ in results]
+            outs = [out for _, out in results]
+            reason = starvation_retry_reason(rcs, outs) or (
+                f"worker rcs={rcs} (unclassified — single-core heartbeat "
+                "starvation is still the most likely cause under tier-1 "
+                "contention)")
             first_bad = next(out for p, out in results if p.returncode)
-            print(f"FLEET RETRY {attempt + 1}/{retries}: worker rcs={rcs} "
-                  "(single-core heartbeat starvation is the known cause; "
-                  "SIGABRT -6 = 'another task died').  First failing "
-                  f"worker tail:\n{first_bad[-600:]}", flush=True)
+            print(f"FLEET RETRY {attempt + 1}/{retries}: {reason}.  "
+                  f"First failing worker tail:\n{first_bad[-600:]}",
+                  flush=True)
     return last
 
 

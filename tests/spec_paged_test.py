@@ -1,0 +1,381 @@
+"""Spec-on-paged: the composed deployment (marker: specpaged;
+docs/SERVING.md 'Engine architecture').
+
+Composition: the Engine assembles orthogonal carry components instead of
+forking programs — the draft pool + verify width (spec) and the block
+tables (paged) compose into ``spec_paged_chunk_step`` through the ONE
+donated builder.  The unit matrix here lowers every registered
+composition and audits its compiled module: each component's pool leaves
+stay donated+aliased in every composition they ride, with no
+full-pool-shaped copy (composing must never cost a resident duplicate).
+
+Engine: greedy bit-parity of the composed executor against the PLAIN slot
+engine token-for-token, through the regimes where the two components
+interact — a prefix-hit admission resuming into a recycled slot (the
+shared span restores BOTH pools' rows), copy-on-write divergence inside a
+shared block mid-draft (both pools copy through the same tables; the
+parent's physical block stays bit-identical in each), and total-rejection
+rounds (a random draft: every round survives on the verify's own token).
+The acceptance-collapse self-disable RECOMPOSES down to the paged
+composition — block tables keep their layout, serving stays bit-correct.
+
+Standalone-runnable (tier-1 truncates at 870s on this box):
+``python -m pytest tests/spec_paged_test.py -q``
+"""
+import numpy as np
+import pytest
+
+from backend import MIXER_BLOCKS, make_params
+from homebrewnlp_tpu.infer.scheduler import (EngineController, EngineRequest,
+                                             SlotScheduler)
+
+pytestmark = pytest.mark.specpaged
+
+SEQ = 32
+PROMPTS = [[1, 2, 3], [7, 8], [4, 5, 6, 7, 9], [10]]
+RLS = [6, 20, 3, None]
+
+
+def _interface(**kw):
+    from homebrewnlp_tpu.infer.interface import InterfaceWrapper
+    from homebrewnlp_tpu.model import Model
+    import jax.numpy as jnp
+    cfg = dict(block_config=MIXER_BLOCKS, memory_reduction_strategy="none",
+               sequence_length=SEQ, train_batch_size=1,
+               decode_loop="stepped", decode_chunk_tokens=5)
+    cfg.update(kw)
+    params = make_params(**cfg)
+    params.train = False
+    model = Model(params)
+    seq = params.sequence_dim.size
+    batch = {"token_x": np.zeros((1, seq, 1), np.int32),
+             "token_y": np.zeros((1, seq, 1), np.int32)}
+    variables = {k: jnp.asarray(v) for k, v in model.init(batch).items()}
+    return InterfaceWrapper(params, model, variables)
+
+
+def _draft_triple(features_per_head=8):
+    """A narrow random-init draft (acceptance ~0 — every verify round is a
+    total rejection), mirroring spec_decode_test's harness draft."""
+    from homebrewnlp_tpu.model import Model
+    import jax.numpy as jnp
+    dparams = make_params(block_config=MIXER_BLOCKS,
+                          memory_reduction_strategy="none",
+                          sequence_length=SEQ, train_batch_size=1,
+                          features_per_head=features_per_head)
+    dparams.train = False
+    dmodel = Model(dparams)
+    zeros = np.zeros((1, SEQ, 1), np.int32)
+    dvars = {k: jnp.asarray(v) for k, v in
+             dmodel.init({"token_x": zeros, "token_y": zeros}).items()}
+    return dparams, dmodel, dvars
+
+
+def _composed(iface, draft, slots=4, block_tokens=4, pool_blocks=None,
+              min_accept_rate=0.0, events=None):
+    from homebrewnlp_tpu.infer.paged import SpecPagedEngineExecutor
+    ex = SpecPagedEngineExecutor(iface, slots, draft, draft_tokens=4,
+                                 min_accept_rate=min_accept_rate,
+                                 block_tokens=block_tokens,
+                                 pool_blocks=pool_blocks)
+    answers = {}
+    sched = SlotScheduler(ex.slots)
+    ctl = EngineController(
+        ex, sched, decode_chunk=5, prefill_chunk=8,
+        answer=lambda req, oc: answers.__setitem__(req.rid, oc),
+        hooks=(lambda event, **k: events.append((event, k)))
+        if events is not None else None)
+    return ex, ctl, sched, answers
+
+
+def _serve(ctl, answers, reqs, rounds=120):
+    ctl.round(reqs)
+    for _ in range(rounds):
+        if all(r.rid in answers for r in reqs):
+            return
+        ctl.round()
+    raise AssertionError(f"unanswered: "
+                         f"{[r.rid for r in reqs if r.rid not in answers]}")
+
+
+def _req(rid, toks, rl):
+    return EngineRequest(rid=rid, path="/token_completion",
+                         toks=np.asarray(toks, np.int32), response_len=rl)
+
+
+def _ref(iface, toks, rl):
+    return np.asarray(iface.complete_tokens(np.asarray(toks, np.int32),
+                                            0.0, rl))
+
+
+def _block_content(ex, phys):
+    """Physical block ``phys``'s rows in BOTH pools (target + draft): the
+    composed carry is (token_x, tpools, dpools, key, seen), and the two
+    pools ride the same tables — a COW must leave the parent's block
+    bit-identical in each."""
+    from homebrewnlp_tpu.infer.paged import classify_cache_leaves
+    from homebrewnlp_tpu.infer.sampler import decode_cache_shapes
+    probe = np.zeros((ex.slots, ex.seq, ex.tps), np.int32)
+    out = {}
+    for tag, model, variables, pools in (
+            ("t", ex.model_w, ex.variables, ex._carry[1]),
+            ("d", ex.draft_model_w, ex.draft_variables, ex._carry[2])):
+        info = classify_cache_leaves(
+            decode_cache_shapes(model, variables, probe), ex.seq)
+        for name, leaf in pools.items():
+            baxis, sax = info[name]
+            if sax is None:
+                continue
+            out[f"{tag}/{name}"] = np.take(np.asarray(leaf), phys,
+                                           axis=baxis).copy()
+    return out
+
+
+# --------------------------------------------------------- engine parity
+
+def spec_paged_perfect_draft_bit_parity_test():
+    """Composed-vs-plain greedy bit-parity token-for-token with the target
+    itself as draft (acceptance 1.0, bonus path exercised) on an UNDERSIZED
+    pool: three admission waves cycle blocks through the free list, so late
+    requests draft-and-verify in reclaimed dirty blocks."""
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    ex, ctl, sched, answers = _composed(
+        iface, (iface.params, iface.model, iface.variables), pool_blocks=16)
+    assert ex.engine.name == "spec_paged_chunk_step"
+    assert ex.sharing
+    waves = [
+        list(zip(PROMPTS, RLS)),
+        [([3, 1, 4], 8), ([2, 7, 1, 8], 10)],
+        [([11, 12, 13, 14, 15], 7), ([9], 20)],
+    ]
+    n = 0
+    for wave in waves:
+        reqs = [_req(f"r{n + i}", toks, rl)
+                for i, (toks, rl) in enumerate(wave)]
+        n += len(wave)
+        _serve(ctl, answers, reqs)
+    n = 0
+    for wave in waves:
+        for toks, rl in wave:
+            kind, got = answers[f"r{n}"]
+            assert kind == "ok", (n, kind)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          _ref(iface, toks, rl), str(n))
+            n += 1
+    s = ex.spec_summary()
+    assert s["enabled"] and s["drafted"] > 0
+    assert s["accept_rate"] == 1.0, s        # the draft IS the target
+    stats = ex.pool_stats()
+    assert stats["blocks_total"] == 16
+    assert stats["blocks_in_use"] == 0       # everything released
+
+
+def spec_paged_prefix_hit_recycled_slot_parity_test():
+    """A prefix-hit admission INTO A RECYCLED SLOT: the second request's
+    shared 16-token span resumes from the radix cache (prefill skipped, q
+    starts past the span) in a slot whose previous occupant's rows — in
+    BOTH pools — were evicted by the admit splice; output is BIT-IDENTICAL
+    to a cold decode of the same prompt."""
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    ex, ctl, sched, answers = _composed(
+        iface, (iface.params, iface.model, iface.variables), slots=2)
+    sysp = list(range(1, 17))                # 16 shared tokens, 4 blocks
+    a, b = sysp + [21, 22], sysp + [23]
+    _serve(ctl, answers, [_req("a", a, 6)])
+    # churn both slots so b's admission recycles one with a dead occupant
+    _serve(ctl, answers, [_req("x0", [5, 6], 4), _req("x1", [8, 9], 4)])
+    st0 = dict(ex.pool_stats())
+    _serve(ctl, answers, [_req("b", b, 6)])
+    st1 = ex.pool_stats()
+    assert st1["prefix_hits"] == st0["prefix_hits"] + 1
+    assert st1["prefix_hit_tokens"] - st0["prefix_hit_tokens"] == 16
+    for rid, toks in (("a", a), ("b", b)):
+        np.testing.assert_array_equal(np.asarray(answers[rid][1]),
+                                      _ref(iface, toks, 6), rid)
+    assert ex.spec_summary()["accept_rate"] == 1.0
+
+
+def spec_paged_cow_mid_draft_parent_blocks_unchanged_test():
+    """Copy-on-write divergence MID-DRAFT: a child sharing two tokens of a
+    promoted block diverges inside it while drafting is active; the write
+    lands in the child's private copy through the shared tables, the
+    parent's physical block stays bit-identical in BOTH pools, and the
+    child's output matches a cold decode."""
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    ex, ctl, sched, answers = _composed(
+        iface, (iface.params, iface.model, iface.variables))
+    parent = [5, 6, 7, 8, 9, 10]             # blocks: [5,6,7,8] + partial
+    _serve(ctl, answers, [_req("parent", parent, 4)])
+    assert ex.pool_stats()["blocks_cached"] >= 1
+    full, _, _ = ex.tree.lookup(parent[:4])
+    assert len(full) == 1
+    phys = full[0].block
+    before = _block_content(ex, phys)
+    assert any(k.startswith("t/") for k in before), before.keys()
+    assert any(k.startswith("d/") for k in before), before.keys()
+    child = [5, 6, 99, 98, 97]               # diverges inside the block
+    cow0 = ex.pool_stats()["cow_copies"]
+    _serve(ctl, answers, [_req("child", child, 5)])
+    assert ex.pool_stats()["cow_copies"] > cow0
+    after = _block_content(ex, phys)
+    for name in before:
+        np.testing.assert_array_equal(before[name], after[name], name)
+    np.testing.assert_array_equal(np.asarray(answers["child"][1]),
+                                  _ref(iface, child, 5))
+
+
+def spec_paged_total_rejection_bit_parity_test():
+    """A random draft over the block pool (acceptance ~0): every verify
+    round is a total rejection that advances on the verify's own sampled
+    token, rejected draft rows in BOTH pools self-heal by overwrite before
+    the next gather reads them, and output stays bit-identical to the
+    plain slot engine."""
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+    ex, ctl, sched, answers = _composed(iface, _draft_triple())
+    reqs = [_req(f"r{i}", p, rl)
+            for i, (p, rl) in enumerate(zip(PROMPTS, RLS))]
+    _serve(ctl, answers, reqs)
+    for i, (p, rl) in enumerate(zip(PROMPTS, RLS)):
+        kind, got = answers[f"r{i}"]
+        assert kind == "ok", (i, kind)
+        np.testing.assert_array_equal(np.asarray(got), _ref(iface, p, rl),
+                                      str(i))
+    s = ex.spec_summary()
+    assert s["enabled"] and s["drafted"] > 0
+    assert s["accept_rate"] < 0.5, s         # the draft is noise
+    assert ex.pool_stats()["blocks_in_use"] == 0
+
+
+def spec_paged_self_disable_recomposes_to_paged_test():
+    """Acceptance collapse on the composed deployment: the self-disable
+    drops the SPEC component only — the Engine recomposes to
+    ``paged_chunk_step`` (block tables keep their layout, prefix sharing
+    stays live) and serving continues bit-identically."""
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.5)
+    events = []
+    ex, ctl, sched, answers = _composed(iface, _draft_triple(),
+                                        min_accept_rate=0.5, events=events)
+    reqs = [_req(f"r{i}", p, rl)
+            for i, (p, rl) in enumerate(zip(PROMPTS, RLS))]
+    _serve(ctl, answers, reqs)
+    for i, (p, rl) in enumerate(zip(PROMPTS, RLS)):
+        kind, got = answers[f"r{i}"]
+        assert kind == "ok", (i, kind)
+        np.testing.assert_array_equal(np.asarray(got), _ref(iface, p, rl),
+                                      str(i))
+    disabled = [k for e, k in events if e == "spec_disabled"]
+    assert disabled and disabled[0]["rate"] < 0.5
+    assert not ex._spec_enabled
+    assert ex.engine.name == "paged_chunk_step"     # recomposed, not reset
+    assert ex.engine.paged is not None
+    # post-disable: the paged composition serves on — including a prefix
+    # hit against blocks the SPEC composition promoted before the flip
+    _serve(ctl, answers, [_req("after", PROMPTS[0] + [15], 5)])
+    np.testing.assert_array_equal(np.asarray(answers["after"][1]),
+                                  _ref(iface, PROMPTS[0] + [15], 5))
+
+
+# ------------------------------------------------ resolution + composition
+
+def spec_paged_knob_resolution_test():
+    """kv_paging=on x spec_decode=draft — the previously-refused pair —
+    resolves the composed executor when a draft is attached; without one
+    the hard pair still refuses loudly (never a silent drop of an explicit
+    requirement), and auto+auto falls back component-wise."""
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.infer.paged import (PagedEngineExecutor,
+                                             SpecPagedEngineExecutor)
+    from homebrewnlp_tpu.infer.rest_api import _resolve_engine
+
+    iface = _interface(spec_draft_tokens=4, spec_min_accept_rate=0.0)
+
+    def resolve(**kw):
+        params = ModelParameter(iface.params, serve_slots=2, **kw)
+        params.train = False
+        return _resolve_engine(params, iface)
+
+    with pytest.raises(RuntimeError):        # no draft anywhere to load
+        resolve(kv_paging="on", spec_decode="draft", kv_block_tokens=4)
+    iface.draft = (iface.params, iface.model, iface.variables)
+    ex = resolve(kv_paging="on", spec_decode="draft", kv_block_tokens=4)
+    assert type(ex) is SpecPagedEngineExecutor
+    assert ex.engine.name == "spec_paged_chunk_step"
+    assert ex.engine.components == {"spec": True, "paged": True}
+    # component-wise fallback: paging geometry the pool cannot carry drops
+    # the paged component under auto, keeping spec on plain slots
+    auto = resolve(kv_paging="auto", spec_decode="draft", kv_block_tokens=7)
+    assert not isinstance(auto, PagedEngineExecutor)
+    assert auto.engine.name == "spec_chunk_step"
+
+
+def engine_recomposition_unit_test():
+    """Engine rows: component flags map to registry names both ways, and
+    dropping a component is recomposition (the survivor keeps its
+    geometry), not a migration to a hand-written pair."""
+    from homebrewnlp_tpu.analysis import entry_points
+    from homebrewnlp_tpu.infer.engine import ENGINE_PROGRAMS, Engine
+    _, model, _, _, _ = entry_points.build_audit_model()
+    _, dmodel, _, _, _ = entry_points.build_audit_model(
+        entry_points.DRAFT_AUDIT_OVERRIDES, seed=1)
+    full = Engine(model, None, draft_model=dmodel, k=3, paged=(4, 16))
+    assert full.name == "spec_paged_chunk_step"
+    assert full.components == {"spec": True, "paged": True}
+    dropped = Engine(model, None, paged=full.paged)
+    assert dropped.name == "paged_chunk_step"
+    assert dropped.paged == (4, 16)          # geometry survives the drop
+    assert Engine(model, None).name == "engine_chunk_step"
+    assert Engine(model, None, draft_model=dmodel,
+                  k=3).name == "spec_chunk_step"
+    assert set(ENGINE_PROGRAMS) == {
+        "engine_chunk_step", "spec_chunk_step", "paged_chunk_step",
+        "spec_paged_chunk_step"}
+
+
+# ----------------------------------------------- carry-composition matrix
+
+def carry_composition_alias_matrix_test():
+    """The unit matrix over the two orthogonal components: EVERY
+    registered composition lowers through the one builder, and every pool
+    leaf of every composition stays donated+aliased with no
+    full-pool-shaped copy — composing components must never cost a
+    resident duplicate of any pool (the HLO audit per composition)."""
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.analysis import entry_points, hlo_lint
+    from homebrewnlp_tpu.infer.engine import ENGINE_PROGRAMS, program_name
+
+    _, model, variables, token_x, _ = entry_points.build_audit_model()
+    _, dmodel, dvars, _, _ = entry_points.build_audit_model(
+        entry_points.DRAFT_AUDIT_OVERRIDES, seed=1)
+    tx = jnp.asarray(token_x)
+    lower = {
+        "engine_chunk_step":
+            lambda: entry_points.lower_engine_step(model, variables, tx),
+        "paged_chunk_step":
+            lambda: entry_points.lower_paged_step(model, variables, tx),
+        "spec_chunk_step":
+            lambda: entry_points.lower_spec_step(
+                model, variables, tx, draft_model=dmodel,
+                draft_variables=dvars),
+        "spec_paged_chunk_step":
+            lambda: entry_points.lower_spec_paged_step(
+                model, variables, tx, draft_model=dmodel,
+                draft_variables=dvars),
+    }
+    leaves = {}
+    for name, parts in ENGINE_PROGRAMS.items():
+        assert program_name(**parts) == name
+        hlo, ctx = lower[name]()
+        assert hlo_lint.input_output_alias_count(hlo) \
+            >= ctx["donated_leaves"], name
+        findings = hlo_lint.audit(name, hlo,
+                                  expected_aliases=ctx["donated_leaves"],
+                                  protected_shapes=ctx["protected"],
+                                  bf16_param_shapes=ctx["bf16_params"],
+                                  budget={})
+        assert findings == [], (name, [str(f) for f in findings])
+        leaves[name] = ctx["donated_leaves"]
+    # each component ADDS its own donated pool leaves to any base it
+    # composes onto — no composition donates less than its parts
+    assert leaves["spec_chunk_step"] > leaves["engine_chunk_step"]
+    assert leaves["spec_paged_chunk_step"] > leaves["paged_chunk_step"]
+    assert leaves["spec_paged_chunk_step"] >= leaves["spec_chunk_step"]

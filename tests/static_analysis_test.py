@@ -266,12 +266,68 @@ def donated_jit_rule_negative_control_test():
     assert ast_lint.lint_source("some/new_module.py", plain) == []
 
 
+def engine_registry_rule_negative_control_test():
+    """A donated jit under infer/ outside the Engine's registered builder
+    sites is a forked chunk-program carry escaping the composition
+    registry: the engine-registry rule flags it (on top of donated-jit);
+    the registered builder stays clean under its key, and the same site
+    OUTSIDE infer/ trips only the donated-jit registration rule."""
+    bad = ("import jax\n"
+           "def my_forked_program():\n"
+           "    return jax.jit(lambda c: c, donate_argnums=(0,))\n")
+    findings = ast_lint.lint_source("homebrewnlp_tpu/infer/forked.py", bad)
+    assert sorted(f.rule for f in findings) == ["donated-jit",
+                                               "engine-registry"]
+    msg = next(f.message for f in findings if f.rule == "engine-registry")
+    assert "ENGINE_PROGRAMS" in msg and "_chunk_jit" in msg
+    # the Engine's single builder passes under its registered key
+    registered = ("import jax\n"
+                  "def _chunk_jit():\n"
+                  "    return jax.jit(lambda c: c, donate_argnums=(0,))\n")
+    assert ast_lint.lint_source("homebrewnlp_tpu/infer/engine.py",
+                                registered) == []
+    # outside infer/ the composition registry does not apply
+    assert [f.rule for f in ast_lint.lint_source(
+        "homebrewnlp_tpu/train/other.py", bad)] == ["donated-jit"]
+    # the suppression marker silences the fork complaint too
+    marked = ("import jax\n"
+              "def my_forked():  # graft-lint: allow[engine-registry]\n"
+              "    return jax.jit(lambda c: c, donate_argnums=(0,))  "
+              "# graft-lint: allow[donated-jit]\n")
+    assert ast_lint.lint_source("homebrewnlp_tpu/infer/forked.py",
+                                marked) == []
+
+
 def registry_keys_point_at_real_sites_test():
-    """Every DONATED_JIT_REGISTRY key names an existing file — a stale key
-    after a refactor would silently stop covering the moved site."""
-    for key in ast_lint.DONATED_JIT_REGISTRY:
+    """Every DONATED_JIT_REGISTRY / ENGINE_REGISTRY_SITES key names an
+    existing file — a stale key after a refactor would silently stop
+    covering (or stop permitting) the moved site."""
+    for key in (set(ast_lint.DONATED_JIT_REGISTRY)
+                | set(ast_lint.ENGINE_REGISTRY_SITES)):
         rel = key.split("::")[0]
         assert os.path.exists(os.path.join(REPO, rel)), key
+    # the Engine builder's registry row promises an audit per composition
+    assert ("homebrewnlp_tpu/infer/engine.py::_chunk_jit"
+            in ast_lint.ENGINE_REGISTRY_SITES)
+
+
+def engine_programs_mirror_entry_points_test():
+    """infer/engine.py ENGINE_PROGRAMS and analysis/entry_points.py
+    ENTRY_POINTS are mirrored, not imported (entry_points must import
+    without jax): the chunk-step tail of the audit registry must list
+    exactly the Engine's compositions in registry order, every
+    (spec, paged) pair must resolve to exactly one program, and the
+    builder's DONATED_JIT_REGISTRY row must name each audit."""
+    from homebrewnlp_tpu.analysis import entry_points
+    from homebrewnlp_tpu.infer.engine import ENGINE_PROGRAMS, program_name
+    progs = list(ENGINE_PROGRAMS)
+    assert list(entry_points.ENTRY_POINTS[-len(progs):]) == progs
+    assert sorted(program_name(**parts)
+                  for parts in ENGINE_PROGRAMS.values()) == sorted(progs)
+    row = ast_lint.DONATED_JIT_REGISTRY[
+        "homebrewnlp_tpu/infer/engine.py::_chunk_jit"]
+    for name in progs:
+        assert name in row, (name, row)
 
 
 def config_docs_rule_negative_control_test(tmp_path):
