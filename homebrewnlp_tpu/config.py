@@ -433,6 +433,24 @@ class ModelParameter:
         # in-flight requests more than the least-loaded replica
         self.serve_affinity_tokens = 32
         self.serve_affinity_slack = 4
+        # ---- disaggregated prefill/decode tier (docs/SERVING.md) ----
+        # split the replica tier into CLASSES, e.g. "prefill:1,decode:2":
+        # prefill-class replicas compute each distinct prompt prefix once,
+        # infer/kv_transfer.py streams the finished KV blocks to decode-
+        # class replicas, and the router's global prefix index routes
+        # follow-up requests to whoever holds the blocks.  "" = symmetric
+        # (classless) tier, byte-identical to today.  Implies the replica
+        # count when serve_replicas is unset; requires kv_paging
+        self.serve_replica_classes = ""
+        # the class THIS process serves under — set per replica by the
+        # fleet (distributed/replica_fleet.py), not by hand; surfaces on
+        # /health so the router and forensics can tell classes apart
+        self.serve_replica_class = ""
+        # cap on blocks per /kv/blocks export (0 = uncapped): bounds one
+        # migration's payload on replicas with huge cached trees
+        self.kv_transfer_max_blocks = 0
+        # router-side timeout for one /kv/blocks export or inject leg
+        self.kv_transfer_timeout_s = 30.0
         # ---- speculative decoding on the slot engine (docs/SERVING.md) ----
         # draft-and-verify on the continuous engine: each slot runs k cheap
         # draft steps with a quarter-width draft model, then ONE width-(k+1)
@@ -683,10 +701,33 @@ class ModelParameter:
             raise ValueError("kv_pool_blocks must be >= 0 (0 = auto), got "
                              f"{self.kv_pool_blocks}")
         for knob in ("serve_replicas", "serve_affinity_tokens",
-                     "serve_affinity_slack"):
+                     "serve_affinity_slack", "kv_transfer_max_blocks"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"{knob} must be >= 0, got "
                                  f"{getattr(self, knob)}")
+        if self.kv_transfer_timeout_s <= 0:
+            raise ValueError("kv_transfer_timeout_s must be > 0, got "
+                             f"{self.kv_transfer_timeout_s}")
+        if self.serve_replica_class not in ("", "prefill", "decode"):
+            raise ValueError("serve_replica_class must be \"\", \"prefill\""
+                             f" or \"decode\", got "
+                             f"{self.serve_replica_class!r}")
+        if self.serve_replica_classes:
+            # parse eagerly: a topology typo must fail at config load, not
+            # after N model loads; the router re-derives the same list
+            from .infer.router import parse_replica_classes
+            classes = parse_replica_classes(self.serve_replica_classes)
+            if self.serve_replicas and self.serve_replicas != len(classes):
+                raise ValueError(
+                    f"serve_replicas={self.serve_replicas} contradicts "
+                    f"serve_replica_classes "
+                    f"({self.serve_replica_classes!r} = "
+                    f"{len(classes)} replicas)")
+            if self.kv_paging == "off":
+                raise ValueError(
+                    "serve_replica_classes needs kv_paging (block "
+                    "streaming moves paged-pool blocks); set kv_paging to "
+                    "\"on\" or \"auto\"")
         # tri-state like serve_engine: a typo would silently serve without
         # (or refuse to serve with) speculation
         if self.spec_decode not in ("off", "draft", "auto"):

@@ -82,13 +82,21 @@ class ReplicaFleet:
     def __init__(self, params, n: int, base_port: int,
                  max_restarts: typing.Optional[int] = None,
                  restart_backoff_s: typing.Optional[float] = None,
-                 target: typing.Callable = _replica_main):
+                 target: typing.Callable = _replica_main,
+                 classes: typing.Optional[typing.Sequence[str]] = None):
         import multiprocessing as mp
 
         self.cfg = dict(getattr(params, "_raw_config", params))
         self.n = int(n)
         self.base_port = int(base_port)
         self.target = target
+        #: per-replica class for the disaggregated tier (docs/SERVING.md);
+        #: rides each replica's cfg as ``serve_replica_class`` so the
+        #: 3-arg spawn target (injectable in tests) stays unchanged
+        self.classes = [str(c or "") for c in (classes or [])]
+        if self.classes and len(self.classes) != self.n:
+            raise ValueError(f"classes ({len(self.classes)}) must match "
+                             f"replica count ({self.n})")
         self.max_restarts = int(
             getattr(params, "serve_child_max_restarts", 5) or 0
             if max_restarts is None else max_restarts)
@@ -110,9 +118,16 @@ class ReplicaFleet:
         # NOT daemonic: a replica spawns its own Manager + HTTP child, and
         # daemonic processes are forbidden children.  stop() (wired to the
         # mode's SIGTERM/SIGINT drain) terminates the fleet instead.
+        cfg = self.cfg
+        if self.classes:
+            cfg = dict(cfg)
+            cfg["serve_replica_class"] = self.classes[index]
+            # a replica inherits the tier config verbatim; its own class
+            # replaces the topology knob (a replica never spawns a tier)
+            cfg.pop("serve_replica_classes", None)
         p = self._ctx.Process(
             target=self.target,
-            args=(self.cfg, self.port(index), index), daemon=False)
+            args=(cfg, self.port(index), index), daemon=False)
         p.start()
         self._procs[index] = p
         self._up_since[index] = time.monotonic()

@@ -1,0 +1,306 @@
+"""KV-block streaming between replicas (docs/SERVING.md 'Disaggregated
+tier').
+
+Prefill is compute-bound, decode cache-bytes-bound — a disaggregated tier
+runs replica CLASSES (``serve_replica_classes``) and moves finished-prefill
+KV between them instead of recomputing it.  This module is the transfer
+half: host-side extraction of the paged pool's block leaves (bf16 KV and
+int8 scale rows alike — extraction is per-leaf, keyed by the
+``BlockPool``/``RadixIndex`` block keys of ``infer/paged.py``), a JSON wire
+format with per-block-per-leaf crc32c reusing the checkpoint manifest
+discipline (``train/checkpoint.py _checksum``), and decode-side injection
+that inserts the streamed blocks into the destination replica's radix tree
+— so the NEXT admission of that prompt takes the ordinary prefix-hit path
+(``PagedEngineExecutor.admit``: read table → shared blocks,
+``q[slot] = shared_len``) and enters the paged admit program already AT its
+divergence point.  No new jit site: injection writes pool leaves with
+eager ``.at[].set`` between donated chunk calls, and the existing
+``{paged,spec_paged}_chunk_step`` programs run unchanged (the
+engine-registry lint stays clean).
+
+The functions here take the executor (``PagedEngineExecutor`` or the
+composed ``SpecPagedEngineExecutor`` — whose draft pool rides the same
+tables and transfers under the ``draft`` pool-set) and are exercised
+device-free-ish on CPU by tests/disagg_test.py; the HTTP seam is
+``/kv/blocks`` in ``infer/rest_api.py``, the routing policy lives in
+``infer/router.py``.
+"""
+from __future__ import annotations
+
+import base64
+import typing
+
+import numpy as np
+
+from ..train.checkpoint import _checksum
+
+#: wire-format version: a receiver refuses newer majors loudly instead of
+#: mis-parsing them
+WIRE_VERSION = 1
+
+
+def _dtype(name: str) -> np.dtype:
+    """Resolve a manifest dtype name, including the ml_dtypes extras
+    (bfloat16) plain numpy does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _verify_block_bytes(data: bytes, meta: dict, ctx: str) -> None:
+    """The checkpoint manifest discipline (train/checkpoint.py
+    ``_verify_bytes``) applied to one streamed block leaf: byte length
+    first, then the recorded crc under its recorded algo (crc32c-masked
+    degrades to length-only when the native lib is absent).  Raises
+    ``ValueError`` — the REST seam renders it as a loud 400, never a
+    silent corrupt injection."""
+    import zlib
+    want_len = meta.get("bytes")
+    if want_len is not None and len(data) != int(want_len):
+        raise ValueError(
+            f"kv_transfer: {ctx} is truncated ({len(data)} bytes, wire "
+            f"records {want_len})")
+    want_crc = meta.get("crc")
+    if want_crc is None:
+        return
+    algo = meta.get("crc_algo", "crc32")
+    if algo == "crc32c-masked":
+        try:
+            from ..data import native_recordio
+            got = native_recordio.masked_crc(data)
+        except Exception:
+            got = None
+        if got is None:  # native lib unavailable: length check stands alone
+            return
+    else:
+        got = zlib.crc32(data) & 0xFFFFFFFF
+    if int(got) != int(want_crc):
+        raise ValueError(
+            f"kv_transfer: {ctx} fails {algo} verification "
+            f"(wire {want_crc}, computed {got})")
+
+
+def _poolsets(executor) -> typing.Optional[dict]:
+    """``{poolset_name: (pools_dict, leaf_info)}`` for the executor's
+    transferable pools, or None before the first dispatch (no carry —
+    the pools are built inside the donated init trace)."""
+    fn = getattr(executor, "transfer_pools", None)
+    if fn is None:
+        return None
+    return fn()
+
+
+def _paged_leaf_names(poolsets: dict) -> typing.List[str]:
+    names = []
+    for ps, (_, info) in sorted(poolsets.items()):
+        names.extend(f"{ps}/{n}" for n, (_, sax) in sorted(info.items())
+                     if sax is not None)
+    return names
+
+
+def export_blocks(executor, tokens: typing.Sequence[int],
+                  max_blocks: int = 0) -> dict:
+    """Extract the cached whole-block prefix of ``tokens`` from the
+    executor's radix tree + pool leaves into the wire format.
+
+    Matches FULL blocks only (partial/COW divergence stays private to its
+    slot — only whole promoted blocks are tree content), capped at
+    ``seq - 1`` tokens like admission.  Returns a payload with zero blocks
+    when there is nothing cached (cold tree, sharing off, or no carry yet)
+    — the router treats that as a stale-index miss, never an error."""
+    bt = int(executor.block_tokens)
+    out = {"version": WIRE_VERSION, "block_tokens": bt, "blocks": []}
+    tree = getattr(executor, "tree", None)
+    poolsets = _poolsets(executor)
+    if tree is None or poolsets is None:
+        return out
+    toks = np.asarray(tokens, np.int64).reshape(-1)[:executor.seq - 1]
+    if len(toks) < bt:
+        return out
+    full, _, _ = tree.lookup(toks)
+    if max_blocks:
+        full = full[:int(max_blocks)]
+    if not full:
+        return out
+    # one host copy per leaf, NOT per block: np.asarray of a pool leaf
+    # materializes the whole pool
+    host: typing.Dict[str, typing.Tuple[np.ndarray, int]] = {}
+    for ps, (pools, info) in poolsets.items():
+        for n, (baxis, sax) in info.items():
+            if sax is not None:
+                host[f"{ps}/{n}"] = (np.asarray(pools[n]), baxis)
+    for node in full:
+        entry = {"key": [int(t) for t in node.key], "leaves": {}}
+        for name, (arr, baxis) in host.items():
+            row = np.ascontiguousarray(np.take(arr, int(node.block),
+                                               axis=baxis))
+            data = row.tobytes()
+            algo, crc = _checksum(data)
+            entry["leaves"][name] = {
+                "shape": list(row.shape), "dtype": str(row.dtype),
+                "bytes": len(data), "crc": int(crc), "crc_algo": algo,
+                "data": base64.b64encode(data).decode("ascii")}
+        out["blocks"].append(entry)
+    return out
+
+
+def payload_bytes(payload: dict) -> int:
+    """Transferred KV bytes of a wire payload (the telemetry number —
+    decoded leaf bytes, not JSON overhead)."""
+    return sum(int(leaf.get("bytes") or 0)
+               for blk in payload.get("blocks", ())
+               for leaf in blk.get("leaves", {}).values())
+
+
+def _alloc_cached(executor) -> typing.Optional[int]:
+    """One block for TREE-owned (refcount-0 cached) content: free list
+    first, then LRU eviction — the ``_alloc_block`` discipline without a
+    slot owner.  None when nothing is allocatable (pool full of live
+    blocks): injection stops early, a shorter prefix is still correct."""
+    pool, tree = executor.pool, executor.tree
+    while pool.free_count == 0:
+        if not tree.evict_lru(pool):
+            return None
+        executor.stats["tree_evictions"] += 1
+    return pool.alloc()
+
+
+def inject_blocks(executor, payload: dict) -> dict:
+    """Insert streamed blocks into the destination replica's pool leaves +
+    radix tree.  Validates the wire version, block geometry and leaf set
+    against THIS deployment and every block's crc BEFORE touching the pool
+    (a corrupt payload is rejected loudly with zero side effects on the
+    rejected block).  Returns ``{"injected", "skipped", "blocks"}`` —
+    ``skipped`` counts path-prefix blocks already cached here (the
+    existing node is canonical) and allocation give-ups."""
+    if int(payload.get("version") or 0) != WIRE_VERSION:
+        raise ValueError(
+            f"kv_transfer: wire version {payload.get('version')!r} "
+            f"(this build speaks {WIRE_VERSION})")
+    bt = int(executor.block_tokens)
+    if int(payload.get("block_tokens") or 0) != bt:
+        raise ValueError(
+            f"kv_transfer: block_tokens {payload.get('block_tokens')!r} "
+            f"does not match this deployment's {bt}")
+    tree = getattr(executor, "tree", None)
+    if tree is None:
+        raise ValueError("kv_transfer: this deployment has no prefix "
+                         "sharing (kv_paging off or recurrent caches) — "
+                         "nothing to inject into")
+    blocks = payload.get("blocks") or []
+    if _poolsets(executor) is None:
+        # the pools live inside the donated carry, which exists only after
+        # the first dispatch: run one empty chunk (no live slots — every
+        # row is masked) to materialize them.  This compiles the init
+        # program the replica needs for its first admission anyway.
+        if blocks:
+            executor.dispatch(1)
+    poolsets = _poolsets(executor)
+    if poolsets is None:
+        return {"injected": 0, "skipped": len(blocks), "blocks": len(blocks)}
+    want = set(_paged_leaf_names(poolsets))
+    # destination geometry per wire leaf name: dtype + the row shape a
+    # scalar take() at the block axis yields — validated per block BEFORE
+    # any pool mutation so a mismatched payload has zero side effects
+    expect = {}
+    for ps, (pools, info) in poolsets.items():
+        for n, (baxis, sax) in info.items():
+            if sax is None:
+                continue
+            dest = pools[n]
+            expect[f"{ps}/{n}"] = (
+                str(dest.dtype),
+                tuple(s for ax, s in enumerate(dest.shape) if ax != baxis))
+    updates: typing.Dict[str, typing.Dict[str, list]] = \
+        {ps: {} for ps in poolsets}
+    injected = skipped = 0
+    node = None  # root-chain insertion cursor
+    for i, blk in enumerate(blocks):
+        key = tuple(int(t) for t in blk.get("key") or ())
+        if len(key) != bt:
+            raise ValueError(f"kv_transfer: block {i} key has {len(key)} "
+                             f"tokens (block_tokens={bt})")
+        parent = node if node is not None else tree.root
+        existing = parent.children.get(key)
+        if existing is not None:
+            # existing child wins (the RadixIndex.insert rule): its rows
+            # are already exactly what a cold walk writes here
+            node = existing
+            skipped += 1
+            continue
+        leaves = blk.get("leaves") or {}
+        if set(leaves) != want:
+            raise ValueError(
+                f"kv_transfer: block {i} carries leaves "
+                f"{sorted(leaves)} but this deployment pages "
+                f"{sorted(want)}")
+        rows = {}
+        for name, meta in leaves.items():
+            dt, shape = expect[name]
+            if str(meta.get("dtype")) != dt \
+                    or tuple(meta.get("shape") or ()) != shape:
+                raise ValueError(
+                    f"kv_transfer: block {i} leaf {name} is "
+                    f"{meta.get('dtype')}{meta.get('shape')} but this "
+                    f"deployment's leaf is {dt}{list(shape)}")
+            data = base64.b64decode(meta.get("data") or "")
+            _verify_block_bytes(data, meta, f"block {i} leaf {name}")
+            rows[name] = np.frombuffer(
+                data, dtype=_dtype(meta["dtype"])).reshape(meta["shape"])
+        b = _alloc_cached(executor)
+        if b is None:
+            skipped += len(blocks) - i
+            break
+        for name, row in rows.items():
+            ps, leaf = name.split("/", 1)
+            updates[ps].setdefault(leaf, []).append((b, row))
+        inserted = tree.insert(parent, key, b)
+        # refcount 0 + tree-held = cached (LRU-evictable) — the promoted-
+        # prompt-block state, reached the same way release() leaves it
+        executor.pool.deref(b)
+        node = inserted
+        injected += 1
+    if injected:
+        new_sets = {}
+        for ps, (pools, info) in poolsets.items():
+            pools = dict(pools)
+            for leaf, writes in updates[ps].items():
+                baxis = info[leaf][0]
+                arr = pools[leaf]
+                idx = [slice(None)] * arr.ndim
+                for b, row in writes:
+                    idx[baxis] = b
+                    arr = arr.at[tuple(idx)].set(np.asarray(row))
+                pools[leaf] = arr
+            new_sets[ps] = pools
+        executor.set_transfer_pools(new_sets)
+    return {"injected": injected, "skipped": skipped, "blocks": len(blocks)}
+
+
+def index_digest(executor, max_paths: int = 256) -> dict:
+    """Compact promote/evict report for the router's GLOBAL prefix index:
+    every root-to-leaf token path the radix tree currently holds (flat
+    token lists, whole blocks only), most-recently-touched first, capped.
+    The router folds these into its prefix → owning-replica map on the
+    existing scrape cadence."""
+    tree = getattr(executor, "tree", None)
+    out = {"block_tokens": int(executor.block_tokens), "paths": []}
+    if tree is None:
+        return out
+    leaves = []
+
+    def walk(n, toks):
+        if not n.children:
+            if toks:
+                leaves.append((n.touch, toks))
+            return
+        for child in n.children.values():
+            walk(child, toks + list(child.key))
+
+    for child in tree.root.children.values():
+        walk(child, list(child.key))
+    leaves.sort(key=lambda e: -e[0])
+    out["paths"] = [toks for _, toks in leaves[:int(max_paths)]]
+    return out

@@ -588,6 +588,26 @@ class PagedEngineExecutor(EngineExecutor):
         self._promo = [None] * self.slots
         self._prompt_toks = [None] * self.slots
 
+    # -- KV transfer (infer/kv_transfer.py, docs/SERVING.md 'Disaggregated
+    # tier'): host handles on the donated carry's pool leaves, so block
+    # streaming between replicas reads/writes them WITHOUT a new jit site
+
+    def transfer_pools(self) -> typing.Optional[dict]:
+        """``{poolset: (pools_dict, leaf_info)}`` of the live carry's
+        block-pool leaves, or None before the first dispatch (the pools
+        are built inside the donated init trace)."""
+        if self._carry is None:
+            return None
+        # paged carry layout: (q, token_x, pools, key, seen)
+        return {"target": (self._carry[2], self.leaf_info)}
+
+    def set_transfer_pools(self, poolsets: dict) -> None:
+        """Swap updated pool leaves back into the carry (eager ``.at[]``
+        writes happened outside the donated programs)."""
+        carry = list(self._carry)
+        carry[2] = poolsets["target"]
+        self._carry = tuple(carry)
+
     # -- observability -------------------------------------------------------
 
     def pool_stats(self) -> dict:
@@ -647,6 +667,37 @@ class SpecPagedEngineExecutor(SpecEngineExecutor, PagedEngineExecutor):
                                      block_tokens=block_tokens,
                                      pool_blocks=pool_blocks)
         self._init_spec(draft, draft_tokens, min_accept_rate)
+
+    def _draft_leaf_info(self) -> typing.Dict[str, tuple]:
+        """Leaf classification for the DRAFT pool (its cache geometry,
+        not the target's), computed once — kv_transfer streams both pools
+        through the shared block tables."""
+        cached = getattr(self, "_draft_leaf_info_cache", None)
+        if cached is None:
+            from .sampler import decode_cache_shapes
+            probe = np.zeros((self.slots, self.seq, self.tps), np.int32)
+            dshapes = decode_cache_shapes(self.draft_model_w,
+                                          self.draft_variables, probe)
+            cached = classify_cache_leaves(dshapes, self.seq)
+            self._draft_leaf_info_cache = cached
+        return cached
+
+    def transfer_pools(self) -> typing.Optional[dict]:
+        if not self._spec_enabled:
+            return PagedEngineExecutor.transfer_pools(self)
+        if self._carry is None:
+            return None
+        # spec-paged carry layout: (token_x, pools, dpools, key, seen)
+        return {"target": (self._carry[1], self.leaf_info),
+                "draft": (self._carry[2], self._draft_leaf_info())}
+
+    def set_transfer_pools(self, poolsets: dict) -> None:
+        if not self._spec_enabled:
+            return PagedEngineExecutor.set_transfer_pools(self, poolsets)
+        carry = list(self._carry)
+        carry[1] = poolsets["target"]
+        carry[2] = poolsets["draft"]
+        self._carry = tuple(carry)
 
     def dispatch(self, steps: int) -> np.ndarray:
         """Acceptance-aware dispatch over the block pool: verify rounds

@@ -44,6 +44,11 @@ from .serving_guard import (HTTPStatusError, ServingGuard, child_health,
 DEFAULT_PORT = 62220
 
 BATCHED_PATHS = ("/completion", "/token_completion")
+#: KV-block streaming endpoint (docs/SERVING.md 'Disaggregated tier'):
+#: registered only on paged deployments with prefix sharing, answered on
+#: the device-loop thread (the one place with executor/carry access) via
+#: the non-batched inline branch of ``_engine_classify``
+KV_BLOCKS_PATH = "/kv/blocks"
 # endpoints load balancers / k8s probe with GET (POST works on them too)
 PROBE_PATHS = ("/health", "/ready")
 # GET-able endpoints: the probes plus the Prometheus scrape target; like the
@@ -1196,6 +1201,46 @@ def _resolve_engine(params: ModelParameter, interface):
         return None
 
 
+def _kv_blocks_handler(params, executor) -> typing.Callable[[dict], dict]:
+    """The ``/kv/blocks`` device-loop handler (docs/SERVING.md
+    'Disaggregated tier'): ``op=export`` streams the cached whole-block
+    prefix of ``tokens`` out in the kv_transfer wire format, ``op=import``
+    injects a streamed payload into this replica's pool + radix tree (the
+    next admission of that prompt then takes the ordinary prefix-hit
+    path), ``op=index`` reports the tree's block-key paths for the
+    router's global prefix index.  Malformed payloads raise ValueError —
+    rendered 400, never a silent corrupt injection."""
+    from . import kv_transfer
+    r = telemetry.registry()
+    exported = r.counter(
+        "hbnlp_disagg_exported_blocks_total",
+        "KV blocks this replica streamed OUT via /kv/blocks export")
+    injected = r.counter(
+        "hbnlp_disagg_injected_blocks_total",
+        "KV blocks this replica accepted via /kv/blocks import into its "
+        "radix cache")
+    max_blocks = int(getattr(params, "kv_transfer_max_blocks", 0) or 0)
+
+    def handler(body: dict) -> dict:
+        op = body.get("op") or ("import" if "blocks" in body else "export")
+        if op == "index":
+            return kv_transfer.index_digest(executor)
+        if op == "export":
+            out = kv_transfer.export_blocks(executor,
+                                            body.get("tokens") or [],
+                                            max_blocks=max_blocks)
+            exported.inc(len(out["blocks"]))
+            return out
+        if op == "import":
+            out = kv_transfer.inject_blocks(executor, body)
+            injected.inc(int(out.get("injected") or 0))
+            return out
+        raise ValueError(f"unknown /kv/blocks op {op!r} "
+                         "(expected export/import/index)")
+
+    return handler
+
+
 def _engine_answer_fn(interface, respond):
     """Adapter: scheduler outcomes -> the responses-dict payload contract
     (same status/code shapes as the batch path, so clients cannot tell the
@@ -1571,8 +1616,17 @@ def serve(params: ModelParameter, interface: InterfaceWrapper,
             prefill_chunk=int(getattr(params, "serve_prefill_chunk_tokens",
                                       128) or 128),
             answer=answer, hooks=hooks)
+    if executor is not None and getattr(executor, "tree", None) is not None:
+        # KV-block streaming (disaggregated tier): only a paged deployment
+        # WITH prefix sharing can export/import blocks — the endpoint's
+        # absence elsewhere keeps non-paged tiers byte-identical
+        handlers[KV_BLOCKS_PATH] = _kv_blocks_handler(params, executor)
     engine_info = {"mode": "continuous" if controller else "batch",
-                   "slots": executor.slots if executor else 0}
+                   "slots": executor.slots if executor else 0,
+                   "kv_transfer": KV_BLOCKS_PATH in handlers,
+                   "replica_class": str(getattr(params,
+                                                "serve_replica_class", "")
+                                        or "")}
     if executor is not None:
         # which ENGINE_PROGRAMS composition this deployment assembled —
         # the same registry name the HLO/mesh audits and budgets key by

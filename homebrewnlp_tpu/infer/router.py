@@ -54,17 +54,53 @@ from .serving_guard import CircuitBreaker, HTTPStatusError
 FORWARD_PATHS = ("/completion", "/token_completion", "/encode", "/decode")
 #: affinity-keyed (prompt-carrying) paths
 COMPLETION_PATHS = ("/completion", "/token_completion")
+#: the replica classes a disaggregated tier runs (docs/SERVING.md
+#: 'Disaggregated tier'); "" = symmetric (classless, today's tier)
+REPLICA_CLASSES = ("prefill", "decode")
+
+
+def parse_replica_classes(spec: str) -> typing.List[str]:
+    """``"prefill:1,decode:2"`` -> ``["prefill", "decode", "decode"]``
+    (the per-replica-index class list).  "" -> [] (symmetric tier).
+    Malformed specs raise ValueError — a typo must not silently serve a
+    symmetric tier under a knob that asked for disaggregation."""
+    spec = (spec or "").strip()
+    if not spec:
+        return []
+    out: typing.List[str] = []
+    for part in spec.split(","):
+        name, _, count = part.strip().partition(":")
+        name = name.strip()
+        if name not in REPLICA_CLASSES:
+            raise ValueError(
+                f"serve_replica_classes: unknown class {name!r} "
+                f"(expected one of {REPLICA_CLASSES})")
+        try:
+            k = int(count.strip() or 1)
+        except ValueError:
+            raise ValueError(
+                f"serve_replica_classes: bad count in {part.strip()!r}")
+        if k < 1:
+            raise ValueError(
+                f"serve_replica_classes: count must be >= 1 in "
+                f"{part.strip()!r}")
+        out.extend([name] * k)
+    return out
 
 
 class Replica:
-    """Router-side view of one replica: address, breaker, in-flight count."""
+    """Router-side view of one replica: address, breaker, in-flight count,
+    and (disaggregated tiers) its class — "prefill", "decode", or "" for
+    the symmetric classless tier."""
 
     def __init__(self, index: int, port: int, host: str = "127.0.0.1",
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 5.0,
-                 clock: typing.Callable[[], float] = time.monotonic):
+                 clock: typing.Callable[[], float] = time.monotonic,
+                 cls: str = ""):
         self.index = int(index)
         self.host = host
         self.port = int(port)
+        self.cls = str(cls or "")
         self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown_s,
                                       clock)
         self.inflight = 0
@@ -143,6 +179,82 @@ def relabel_exposition(text: str, replica: int,
     return out
 
 
+#: replica-side KV-block streaming endpoint (mirrors
+#: ``rest_api.KV_BLOCKS_PATH``; kept literal here so the router module
+#: stays device-free and import-light)
+KV_BLOCKS_PATH = "/kv/blocks"
+
+
+class GlobalPrefixIndex:
+    """Router-resident radix over whole-BLOCK prompt prefixes -> owning
+    replica index: the global half of the per-replica ``RadixIndex``
+    (``infer/paged.py``).  Learned two ways: on-forward (the router knows
+    which replica just prefilled a prompt) and from replicas'
+    ``/kv/blocks`` index digests riding the poll-loop scrape cadence
+    (``Router.sync_global_index``).  Entries are HINTS, never truth: a
+    stale owner degrades to cold prefill and gets invalidated
+    (``Router._forward_disagg``), so the index may be lossy, LRU-capped,
+    and lock-cheap."""
+
+    def __init__(self, block_tokens: int = 16, cap: int = 4096):
+        self.block_tokens = max(1, int(block_tokens))
+        self.cap = int(cap)
+        #: whole-block token-prefix tuple -> replica index, LRU-ordered
+        self._map: "collections.OrderedDict[tuple, int]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def _prefixes(self, tokens) -> typing.List[tuple]:
+        """Whole-block prefixes of ``tokens``, longest first."""
+        toks = tuple(int(t) for t in tokens)
+        bt = self.block_tokens
+        return [toks[:i * bt] for i in range(len(toks) // bt, 0, -1)]
+
+    def record(self, tokens, owner: int) -> None:
+        """Mark ``owner`` as holding every whole-block prefix of
+        ``tokens`` (radix semantics: holding a path implies holding its
+        ancestors)."""
+        with self._lock:
+            for key in self._prefixes(tokens):
+                self._map[key] = int(owner)
+                self._map.move_to_end(key)
+            while len(self._map) > self.cap:
+                self._map.popitem(last=False)
+
+    def lookup(self, tokens) -> typing.Tuple[typing.Optional[int], int]:
+        """Longest whole-block prefix match: ``(owner, depth_tokens)``,
+        ``(None, 0)`` on miss."""
+        with self._lock:
+            for key in self._prefixes(tokens):
+                owner = self._map.get(key)
+                if owner is not None:
+                    self._map.move_to_end(key)
+                    return owner, len(key)
+        return None, 0
+
+    def invalidate_owner(self, owner: int) -> int:
+        """Drop every entry naming ``owner`` (replica death or open
+        breaker); returns the number dropped."""
+        with self._lock:
+            dead = [k for k, v in self._map.items() if v == int(owner)]
+            for k in dead:
+                del self._map[k]
+        return len(dead)
+
+    def absorb(self, owner: int, digest: dict) -> None:
+        """Fold one replica's ``/kv/blocks`` index digest (its
+        promote/evict report) into the global view."""
+        bt = int(digest.get("block_tokens") or 0)
+        if bt and bt != self.block_tokens:
+            return  # mismatched block geometry is not addressable here
+        for path in digest.get("paths") or []:
+            self.record(path, owner)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
 class Router:
     """Dispatch policy + forwarding.  ``transport(replica, path, body,
     timeout)`` is injectable (tests drive the state machine with fakes)."""
@@ -152,13 +264,30 @@ class Router:
                  forward_timeout_s: float = 150.0,
                  transport: typing.Callable = _http_transport,
                  clock: typing.Callable[[], float] = time.monotonic,
-                 trace_requests: bool = False):
+                 trace_requests: bool = False,
+                 classes: typing.Optional[typing.Sequence[str]] = None,
+                 block_tokens: int = 16,
+                 kv_transfer_timeout_s: float = 30.0,
+                 index_sync_interval_s: float = 5.0):
         self.replicas = list(replicas)
         self.affinity_tokens = int(affinity_tokens)
         self.affinity_slack = int(affinity_slack)
         self.forward_timeout_s = float(forward_timeout_s)
         self.transport = transport
         self.clock = clock
+        #: disaggregated tier (docs/SERVING.md): per-replica class list;
+        #: dispatch goes class-aware only when BOTH classes are present,
+        #: so a symmetric tier stays byte-identical to today's behavior
+        self.classes = [str(c or "") for c in (classes or [])]
+        for rep, cls in zip(self.replicas, self.classes):
+            rep.cls = cls
+        self.disagg = ("prefill" in self.classes
+                       and "decode" in self.classes)
+        self.gindex = GlobalPrefixIndex(block_tokens) if self.disagg \
+            else None
+        self.kv_transfer_timeout_s = float(kv_transfer_timeout_s)
+        self.index_sync_interval_s = float(index_sync_interval_s)
+        self._last_index_sync = -float("inf")
         #: request tracing (docs/OBSERVABILITY.md): the router MINTS the
         #: trace id (or adopts the client's header) and propagates it to
         #: the replica, recording a router/forward span per attempt
@@ -183,6 +312,20 @@ class Router:
             "hbnlp_router_replica_breaker",
             "per-replica breaker state: 0=closed 1=half_open 2=open",
             ("replica",))
+        self._m_dindex = r.counter(
+            "hbnlp_disagg_index_total",
+            "global prefix index decisions: hit / miss / stale",
+            ("result",))
+        self._m_dbytes = r.counter(
+            "hbnlp_disagg_transfer_bytes_total",
+            "KV block payload bytes migrated between replicas")
+        self._m_dseconds = r.histogram(
+            "hbnlp_disagg_transfer_seconds",
+            "per-migration KV transfer wall time (export + inject)")
+        self._m_dmigrations = r.counter(
+            "hbnlp_disagg_migrations_total",
+            "KV block migrations between replicas, by outcome",
+            ("outcome",))
 
     # -- policy --------------------------------------------------------------
 
@@ -206,14 +349,29 @@ class Router:
         (half-open's next forward is its probe)."""
         return [r for r in self.replicas if r.breaker.tick() != "open"]
 
+    def _raise_unavailable(self) -> typing.NoReturn:
+        retry = min(r.breaker.retry_after() for r in self.replicas)
+        raise HTTPStatusError(
+            503, {"error": "all replicas unavailable (breakers open)",
+                  "code": "unavailable"}, retry_after=max(1.0, retry))
+
+    def _class_replicas(self, cls: str,
+                        pool: typing.Optional[typing.List[Replica]] = None,
+                        exclude: typing.Optional[Replica] = None
+                        ) -> typing.List[Replica]:
+        pool = self._usable() if pool is None else pool
+        return [r for r in pool if r.cls == cls and r is not exclude]
+
+    @staticmethod
+    def _least(pool: typing.List[Replica]) -> typing.Optional[Replica]:
+        return min(pool, key=lambda r: (r.inflight, r.index)) \
+            if pool else None
+
     def pick(self, path: str, body: dict) -> Replica:
         """Choose a replica, or raise 503 when every breaker is open."""
         usable = self._usable()
         if not usable:
-            retry = min(r.breaker.retry_after() for r in self.replicas)
-            raise HTTPStatusError(
-                503, {"error": "all replicas unavailable (breakers open)",
-                      "code": "unavailable"}, retry_after=max(1.0, retry))
+            self._raise_unavailable()
         least = min(usable, key=lambda r: (r.inflight, r.index))
         key = self._prefix_key(path, body)
         if key is None:
@@ -252,17 +410,203 @@ class Router:
         if self.trace_requests:
             trace = tracectx.trace_id_from_headers(headers) \
                 or tracectx.new_trace_id()
+        if self.gindex is not None and path == "/token_completion":
+            # disaggregated tier: block-keyed class-aware dispatch (text
+            # /completion prompts are not block-addressable router-side,
+            # so they keep the affinity path below)
+            return self._forward_disagg(path, body, trace)
         first = self.pick(path, body)
+        return self._forward_retrying(first, path, body, trace)
+
+    def _forward_retrying(self, first: Replica, path: str, body: dict,
+                          trace: typing.Optional[str],
+                          learn_span: int = 0) -> dict:
+        """``_forward_one`` with the one-cross-replica-retry discipline;
+        a 5xx/unreachable first attempt also drops the failed replica's
+        global-index entries.  ``learn_span`` > 0 records the answering
+        replica as owner of that whole-block token span (the on-forward
+        half of global index maintenance)."""
+        target = first
         try:
-            return self._forward_one(first, path, body, trace)
+            payload = self._forward_one(target, path, body, trace)
         except HTTPStatusError as e:
             if e.status < 500:
                 raise
-            retry_on = [r for r in self._usable() if r is not first]
+            if self.gindex is not None:
+                self.gindex.invalidate_owner(target.index)
+            retry_on = [r for r in self._usable() if r is not target]
             if not retry_on:
                 raise
-            second = min(retry_on, key=lambda r: (r.inflight, r.index))
-            return self._forward_one(second, path, body, trace)
+            target = min(retry_on, key=lambda r: (r.inflight, r.index))
+            payload = self._forward_one(target, path, body, trace)
+        if learn_span > 0 and self.gindex is not None:
+            toks = body.get("tokens") or []
+            self.gindex.record(list(toks)[:learn_span], target.index)
+        return payload
+
+    def _forward_disagg(self, path: str, body: dict,
+                        trace: typing.Optional[str]) -> dict:
+        """Class-aware dispatch (docs/SERVING.md 'Disaggregated tier').
+
+        * index miss — or a shallow hit covering no more than half the
+          span — -> least-loaded PREFILL-class replica computes the
+          prefix ONCE and becomes its owner (short no-block prompts skip
+          straight to the decode class instead)
+        * hit, decode-class owner -> route-to-owner: blocks live there
+        * hit, prefill-class owner -> migrate blocks to the least-loaded
+          decode replica and answer from there
+        * owner dead / breaker open / migration failed -> invalidate the
+          stale entries and cold-prefill on a usable replica — a degraded
+          answer, never a 500
+        """
+        toks = body.get("tokens") or []
+        if not isinstance(toks, (list, tuple)):
+            toks = []
+        usable = self._usable()
+        if not usable:
+            self._raise_unavailable()
+        # admission prefix-matches at most plen-1 tokens (paged.py), so
+        # the transferable span is the whole blocks of toks[:-1]
+        span = max(0, len(toks) - 1) // self.gindex.block_tokens \
+            * self.gindex.block_tokens
+        if span <= 0:
+            # short-prompt (long-decode) work goes straight to the decode
+            # class so it never queues behind a prefill
+            target = self._least(self._class_replicas("decode", usable)) \
+                or self._least(usable)
+            return self._forward_retrying(target, path, body, trace)
+        owner_idx, depth = self.gindex.lookup(toks[:span])
+        if owner_idx is None or depth * 2 <= span:
+            # miss, or a shallow hit covering no more than half the span
+            # (typically just a shared system head): the majority of the
+            # prompt still needs prefill, so this is prefill-class work —
+            # migrating the sliver would move the heavy prefill onto a
+            # decode replica instead
+            result = "miss" if owner_idx is None else "shallow"
+            self._m_dindex.labels(result=result).inc()
+            target = self._least(self._class_replicas("prefill", usable)) \
+                or self._least(usable)
+            return self._forward_retrying(target, path, body, trace,
+                                          learn_span=span)
+        owner = self.replicas[owner_idx] \
+            if 0 <= owner_idx < len(self.replicas) else None
+        if owner is None or owner.breaker.tick() == "open":
+            # stale ownership (satellite: owner death / open breaker must
+            # degrade, not 500): drop its entries, cold prefill elsewhere
+            self.gindex.invalidate_owner(owner_idx)
+            self._m_dindex.labels(result="stale").inc()
+            self._m_dmigrations.labels(outcome="cold_fallback").inc()
+            target = self._least(self._class_replicas("prefill", usable,
+                                                      exclude=owner)) \
+                or self._least([r for r in usable if r is not owner])
+            if target is None:
+                self._raise_unavailable()
+            return self._forward_retrying(target, path, body, trace,
+                                          learn_span=span)
+        self._m_dindex.labels(result="hit").inc()
+        if owner.cls != "prefill":
+            # route-to-owner: the decode-class owner already holds the
+            # blocks (a dead owner invalidates + retries inside)
+            return self._forward_retrying(owner, path, body, trace,
+                                          learn_span=span)
+        dec = self._least(self._class_replicas("decode", usable,
+                                               exclude=owner))
+        if dec is None:
+            # no decode replica up: the owner answers directly
+            return self._forward_retrying(owner, path, body, trace,
+                                          learn_span=span)
+        if self._migrate(owner, dec, list(toks[:span]), trace):
+            self.gindex.record(toks[:span], dec.index)
+            return self._forward_retrying(dec, path, body, trace,
+                                          learn_span=span)
+        # migration failed (owner died mid-stream, blocks evicted, pool
+        # full on the far side): cold prefill on the decode replica
+        self._m_dmigrations.labels(outcome="cold_fallback").inc()
+        return self._forward_retrying(dec, path, body, trace,
+                                      learn_span=span)
+
+    def _migrate(self, src: Replica, dst: Replica, tokens: list,
+                 trace: typing.Optional[str]) -> bool:
+        """Export ``tokens``'s finished blocks from ``src`` and inject
+        them into ``dst`` (``infer/kv_transfer.py`` wire format over the
+        replica-side ``/kv/blocks`` endpoint).  Never raises — the caller
+        degrades to cold prefill on False.  Records the ``kv_transfer``
+        hop span (success or not) plus transfer telemetry."""
+        t0 = self.clock()
+        outcome = "failed"
+        moved_bytes = 0
+        try:
+            try:
+                status, payload = self.transport(
+                    src, KV_BLOCKS_PATH,
+                    {"op": "export", "tokens": list(tokens)},
+                    self.kv_transfer_timeout_s)
+            except Exception:
+                # owner died mid-stream: its ownership is stale everywhere
+                src.failures += 1
+                src.breaker.record_failure()
+                self.gindex.invalidate_owner(src.index)
+                return False
+            if status >= 400 or not payload.get("blocks"):
+                return False
+            moved_bytes = sum(
+                int(leaf.get("bytes") or 0)
+                for block in payload.get("blocks") or []
+                for leaf in (block.get("leaves") or {}).values())
+            body = dict(payload)
+            body["op"] = "import"
+            try:
+                status, res = self.transport(dst, KV_BLOCKS_PATH, body,
+                                             self.kv_transfer_timeout_s)
+            except Exception:
+                dst.failures += 1
+                dst.breaker.record_failure()
+                return False
+            if status >= 400:
+                return False
+            if int(res.get("injected") or 0) \
+                    + int(res.get("skipped") or 0) <= 0:
+                return False
+            outcome = "ok"
+            self._m_dbytes.inc(moved_bytes)
+            self._m_dseconds.observe(self.clock() - t0)
+            self._m_dmigrations.labels(outcome="ok").inc()
+            return True
+        finally:
+            if trace is not None:
+                # the kv_transfer hop (docs/OBSERVABILITY.md): one span
+                # per migration attempt so the merged trace shows where
+                # transfer time went
+                tracectx.record_span(trace, "kv_transfer", t0,
+                                     self.clock() - t0, src=src.index,
+                                     dst=dst.index, bytes=moved_bytes,
+                                     outcome=outcome)
+
+    def sync_global_index(self, force: bool = False) -> int:
+        """Fold each usable replica's ``/kv/blocks`` index digest (its
+        promote/evict report) into the global prefix index, riding the
+        serve loop's poll cadence.  Best-effort and self-throttled;
+        returns the number of replicas folded."""
+        if self.gindex is None:
+            return 0
+        now = self.clock()
+        if not force and now - self._last_index_sync \
+                < self.index_sync_interval_s:
+            return 0
+        self._last_index_sync = now
+        folded = 0
+        for rep in self._usable():
+            try:
+                status, digest = self.transport(
+                    rep, KV_BLOCKS_PATH, {"op": "index"},
+                    self.kv_transfer_timeout_s)
+            except Exception:
+                continue  # scrape is best-effort; forwards own the breaker
+            if status >= 400:
+                continue
+            self.gindex.absorb(rep.index, digest)
+            folded += 1
+        return folded
 
     def _forward_one(self, replica: Replica, path: str, body: dict,
                      trace: typing.Optional[str] = None) -> dict:
@@ -396,10 +740,18 @@ def serve_replicated(params, workers: int = 1,
     from ..distributed.replica_fleet import ReplicaFleet
     from .rest_api import DEFAULT_PORT, _run_http
 
+    classes = parse_replica_classes(
+        getattr(params, "serve_replica_classes", "") or "")
     n = int(getattr(params, "serve_replicas", 0) or 0)
+    if classes:
+        if n and n != len(classes):
+            raise ValueError(
+                f"serve_replicas={n} contradicts serve_replica_classes "
+                f"({len(classes)} replicas)")
+        n = len(classes)
     if n < 2:
-        raise ValueError(f"serve_replicated needs serve_replicas >= 2, "
-                         f"got {n}")
+        raise ValueError(f"serve_replicated needs serve_replicas >= 2 "
+                         f"(or a serve_replica_classes topology), got {n}")
     port = DEFAULT_PORT if port is None else int(port)
     telemetry.register_build_info()
     trace_on = bool(getattr(params, "trace_requests", False)) \
@@ -411,7 +763,8 @@ def serve_replicated(params, workers: int = 1,
         flight.configure(params.model_path, "router",
                          capacity=getattr(params,
                                           "telemetry_blackbox_events", 4096))
-    fleet = ReplicaFleet(params, n, base_port=port + 1)
+    fleet = ReplicaFleet(params, n, base_port=port + 1,
+                         classes=classes or None)
     router = Router(
         [Replica(i, port + 1 + i,
                  breaker_threshold=int(getattr(params,
@@ -424,7 +777,11 @@ def serve_replicated(params, workers: int = 1,
         affinity_slack=int(getattr(params, "serve_affinity_slack", 4)),
         forward_timeout_s=float(getattr(params, "serve_request_deadline_s",
                                         120.0)) + 30.0,
-        trace_requests=trace_on)
+        trace_requests=trace_on,
+        classes=classes or None,
+        block_tokens=int(getattr(params, "kv_block_tokens", 16) or 16),
+        kv_transfer_timeout_s=float(getattr(params, "kv_transfer_timeout_s",
+                                            30.0) or 30.0))
     if control is not None:
         control["router"] = router
         control["fleet"] = fleet
@@ -459,10 +816,12 @@ def serve_replicated(params, workers: int = 1,
                                                   0) or 0)},
             daemon=True)
         server.start()
-        print(f"replica tier on :{port} — router + {n} replicas on "
+        tier = f"{','.join(classes)} tier" if classes else "symmetric tier"
+        print(f"replica {tier} on :{port} — router + {n} replicas on "
               f":{port + 1}..:{port + n}")
         while stop is None or not stop.is_set():
             fleet.poll()
+            router.sync_global_index()
             if trace_on:
                 flight.maybe_flush(2.0)
             if stop is None:

@@ -141,6 +141,11 @@ def query_mode(params: ModelParameter, args):
 
 def web_api_mode(params: ModelParameter, args):
     replicas = int(getattr(params, "serve_replicas", 0) or 0)
+    if replicas < 2 and getattr(params, "serve_replica_classes", ""):
+        # a class topology (docs/SERVING.md 'Disaggregated tier') implies
+        # the replica count; serve_replicated re-derives the same list
+        from ..infer.router import parse_replica_classes
+        replicas = len(parse_replica_classes(params.serve_replica_classes))
     if replicas >= 2:
         # multi-replica tier (docs/SERVING.md): the parent stays
         # DEVICE-FREE — each replica subprocess loads the model itself —

@@ -1137,6 +1137,393 @@ def run_replicas(args) -> dict:
     }
 
 
+# ---- disaggregated prefill/decode tier (--disagg; docs/SERVING.md
+# 'Disaggregated tier') --------------------------------------------------------
+#
+# The ISSUE 19 headline: at EQUAL replica count, a prefill:1,decode:2 class
+# tier (router-resident global prefix index + KV-block streaming between
+# replicas) against today's symmetric 3-replica tier, on the mixed workload
+# disaggregation exists for — warm-session probes (long shared prefix, one
+# output token: the TTFT population), long-decode requests (the throughput
+# carriers), and cold new sessions arriving mid-window (the interference).
+# Every session prompt opens with the SAME 32-token system head (the chat
+# regime), which is exactly the affinity map's blind spot: its key is the
+# first `serve_affinity_tokens`=32 tokens, so every family collides on one
+# key and overload spills re-learn the key elsewhere — each spill turns the
+# next probe of EVERY family into a duplicate cold prefill.  The global
+# index keys on whole-block prefixes longest-first, so families stay
+# distinct and warm requests route to (or migrate to) the replica that
+# already holds their blocks.
+#
+# One-core rig: like --replicas, real CPU decode serializes across replica
+# processes, so each replica emulates a COMPUTE-BOUND device — every
+# dispatch sleeps `wait * tokens_advanced` (prefill chunks cost their token
+# count, prefix-hit admissions cost only the divergent tail, idle dispatches
+# cost nothing).  Sleeps overlap across processes, so the tier topology —
+# not the single host core — sets the wall time.  Silicon re-measure queued
+# on the tunnel like every prior row.
+
+DISAGG_CLASSES = ("prefill", "decode", "decode")
+DISAGG_BLOCK_TOKENS = 8
+DISAGG_SHARED_HEAD = 32      # shared system head == default affinity_tokens
+DISAGG_PREFIX_TOKENS = 64    # whole session prefix (8 full blocks)
+DISAGG_FAMILIES = 4          # warm session families
+DISAGG_HITS_PER_FAMILY = 10  # timed warm probes per family (TTFT samples)
+DISAGG_DECODE_HEAVY = 12     # short-prompt long-decode requests
+DISAGG_NEWCOMERS = 4         # cold sessions arriving inside the window
+DISAGG_TOKEN_WAIT_S = 0.01   # emulated device seconds per token processed
+DISAGG_OVERRIDES = {
+    "sequence_length": 96, "serve_engine": "continuous", "kv_paging": "on",
+    "kv_block_tokens": DISAGG_BLOCK_TOKENS, "kv_pool_blocks": 144,
+    "serve_prefill_chunk_tokens": 8, "decode_chunk_tokens": 4,
+    "trace_requests": True,
+}
+
+
+def _disagg_prefix(family: int):
+    """Session prompt: the shared 32-token system head + a 32-token
+    family-specific history (8 full blocks total)."""
+    import numpy as np
+    head = [((7 * i) % 251) + 1 for i in range(DISAGG_SHARED_HEAD)]
+    rng = np.random.default_rng(5000 + family)
+    tail = [int(x) for x in rng.integers(
+        1, 255, DISAGG_PREFIX_TOKENS - DISAGG_SHARED_HEAD)]
+    return head + tail
+
+
+def _disagg_replica_main(cfg, port, index):
+    """Replica subprocess body for the --disagg tiers: paged serving stack
+    with the per-replica blackbox tag and the compute-bound device
+    emulation (sleep per token each dispatch actually advanced)."""
+    cfg = dict(cfg)
+    wait = float(cfg.pop("_bench_tok_wait_s", 0.0) or 0.0)
+    import numpy as np
+    import jax.numpy as jnp
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.distributed.replica_fleet import install_replica_stop
+    from homebrewnlp_tpu.infer.interface import InterfaceWrapper
+    from homebrewnlp_tpu.infer.rest_api import serve
+    from homebrewnlp_tpu.model import Model
+
+    stop = install_replica_stop()
+    params = ModelParameter(cfg)
+    params.train = False
+    if getattr(params, "trace_requests", False) and params.model_path:
+        # replica-indexed blackbox tag BEFORE serve() (same discipline as
+        # replica_fleet._replica_main) so forensics can merge the tier
+        from homebrewnlp_tpu.telemetry import events as _flight
+        _flight.configure(params.model_path, f"r{index}")
+    if wait:
+        from homebrewnlp_tpu.infer import paged as _paged
+        _orig = _paged.PagedEngineExecutor.dispatch
+
+        def _paced(self, steps, _orig=_orig):
+            before = self.q.copy()
+            out = _orig(self, steps)
+            adv = float(np.clip(np.asarray(out) - before, 0, None).sum())
+            if adv:
+                time.sleep(wait * adv)
+            return out
+
+        _paged.PagedEngineExecutor.dispatch = _paced
+    model = Model(params)
+    seq = params.sequence_dim.size
+    tps = params.token_patch_dim.size
+    zeros = np.zeros((1, seq, tps), np.int32)
+    variables = {k: jnp.asarray(v)
+                 for k, v in model.init({"token_x": zeros,
+                                         "token_y": zeros}).items()}
+    interface = InterfaceWrapper(params, model, variables)
+    print(f"[replica {index}] disagg bench replica "
+          f"({cfg.get('serve_replica_class') or 'symmetric'}) on :{port}",
+          flush=True)
+    serve(params, interface, port=port, isolate=True, stop=stop)
+
+
+def _load_forensics():
+    """scripts/forensics.py as a module (the --trace merge helpers)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "forensics.py")
+    spec = importlib.util.spec_from_file_location("_bench_forensics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _scrape_labeled(port, name):
+    """{label_suffix: value} for one labeled series on /metrics."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    out = {}
+    for labels, val in re.findall(rf'^{name}{{([^}}]*)}} ([0-9.e+-]+)',
+                                  text, re.M):
+        out[labels] = out.get(labels, 0.0) + float(val)
+    return out
+
+
+def _disagg_timed_requests(args):
+    """The seeded mixed workload: (kind, payload) list, shuffled."""
+    import numpy as np
+    reqs = []
+    for f in range(DISAGG_FAMILIES):
+        for j in range(DISAGG_HITS_PER_FAMILY):
+            reqs.append(("probe", {"tokens": _disagg_prefix(f) + [30 + j],
+                                   "max_tokens": 1, "temperature": 0.0}))
+    # the held-back family (warmed cold-only, never re-probed in the warm
+    # phase) migrates INSIDE the timed window, so the kv_transfer hop
+    # rides a traced request into the merged per-hop rows
+    for j in range(3):
+        reqs.append(("probe", {"tokens": _disagg_prefix(DISAGG_FAMILIES)
+                               + [70 + j],
+                               "max_tokens": 1, "temperature": 0.0}))
+    for i in range(DISAGG_DECODE_HEAVY):
+        rng = np.random.default_rng(7000 + i)
+        toks = [int(x) for x in rng.integers(1, 255, 4)]
+        reqs.append(("decode", {"tokens": toks, "max_tokens": 32,
+                                "temperature": 0.0}))
+    for k in range(DISAGG_NEWCOMERS):
+        reqs.append(("cold", {"tokens": _disagg_prefix(50 + k) + [9],
+                              "max_tokens": 4, "temperature": 0.0}))
+    order = np.random.default_rng(args.seed).permutation(len(reqs))
+    return [reqs[i] for i in order]
+
+
+def _run_disagg_tier(label: str, classes, args, wait_s: float) -> dict:
+    """One tier (class topology or symmetric) end to end: real fleet +
+    in-process router, warm/migrate phase, timed closed loop, merged
+    per-hop trace rows."""
+    import tempfile
+    import numpy as np
+    from homebrewnlp_tpu.config import ModelParameter
+    from homebrewnlp_tpu.distributed.replica_fleet import ReplicaFleet
+    from homebrewnlp_tpu.infer import rest_api
+    from homebrewnlp_tpu.infer.router import Replica, Router
+    from homebrewnlp_tpu.telemetry import events as flight
+    from homebrewnlp_tpu.telemetry import tracectx
+
+    scratch = tempfile.mkdtemp(prefix=f"bench_disagg_{label}_")
+    n = len(DISAGG_CLASSES)
+    cfg = {**BENCH_CONFIG, **DISAGG_OVERRIDES, "serve_slots": args.slots,
+           "model_path": scratch, "_bench_tok_wait_s": wait_s}
+    params = ModelParameter({k: v for k, v in cfg.items()
+                             if not k.startswith("_")})
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        router_port = s.getsockname()[1]
+    base = router_port + 1
+    fleet = ReplicaFleet(params, n, base_port=base,
+                         target=_disagg_replica_main,
+                         classes=list(classes) if classes else None)
+    fleet.cfg = dict(cfg)  # ride the bench-only _bench_tok_wait_s through
+    # the router IS this process: its blackbox (kv_transfer +
+    # router/forward spans) lands next to the replicas' for the merge
+    flight.recorder().clear()
+    flight.configure(scratch, "router")
+    router = Router([Replica(i, base + i) for i in range(n)],
+                    forward_timeout_s=300.0, trace_requests=True,
+                    classes=list(classes) if classes else None,
+                    block_tokens=DISAGG_BLOCK_TOKENS,
+                    kv_transfer_timeout_s=120.0)
+
+    def dispatch(path, body, headers=None):
+        if path == "/health":
+            return router.health()
+        if path == "/metrics":
+            return {"_prometheus": router.metrics()}
+        return router.forward(path, body, headers)
+
+    def fire(payload, tid=None, timeout=600.0):
+        headers = {tracectx.TRACE_HEADER: tid} if tid else None
+        return _post(router_port, payload, timeout=timeout, headers=headers)
+
+    def fire_all(payloads):
+        threads = [threading.Thread(target=fire, args=(p,), daemon=True)
+                   for p in payloads]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+
+    canary_payload = {"tokens": _disagg_prefix(0) + [200], "max_tokens": 8,
+                      "temperature": 0.0}
+    results = []
+    lock = threading.Lock()
+    try:
+        fleet.start()
+        threading.Thread(
+            target=rest_api._run_http,
+            args=(router_port,
+                  ["/token_completion", "/health", "/metrics"],
+                  dispatch, max(8, args.concurrency)), daemon=True).start()
+        deadline = time.monotonic() + 900
+        while True:
+            try:
+                h = _wait_up(router_port, deadline_s=30)
+                if all("health" in r for r in h.get("replicas", ())):
+                    break
+            except Exception:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{label} tier never came up")
+            time.sleep(1.0)
+        # compile warm, spread over the tier (in the class tier these are
+        # short decodes -> the decode replicas; the prefill replica
+        # compiles on the first session cold below)
+        fire_all([{"tokens": [21 + i, 22, 23, 24], "max_tokens": 4,
+                   "temperature": 0.0} for i in range(2 * n)])
+        # session colds, sequential: exactly one cold prefill per family
+        # (the +1 held-back family is warmed cold-only — its migration
+        # happens inside the timed window, carrying a traced kv_transfer
+        # span into the merged per-hop rows)
+        for f in range(DISAGG_FAMILIES + 1):
+            status, body = fire({"tokens": _disagg_prefix(f) + [9],
+                                 "max_tokens": 1, "temperature": 0.0})
+            assert status == 200, body
+        # greedy canary, pass 1 (class tier: triggers family-0's
+        # block migration to a decode replica)
+        status, canary_a = fire(canary_payload)
+        assert status == 200, canary_a
+        # concurrent re-probes: the class tier migrates the remaining
+        # families' blocks to decode replicas; the symmetric tier warms
+        # its affinity map
+        fire_all([{"tokens": _disagg_prefix(f) + [8], "max_tokens": 1,
+                   "temperature": 0.0} for f in range(DISAGG_FAMILIES)])
+        # greedy canary, pass 2 (class tier: answered by a decode-class
+        # replica from the STREAMED blocks) — must match pass 1 bit-exact
+        status, canary_b = fire(canary_payload)
+        assert status == 200, canary_b
+
+        shuffled = _disagg_timed_requests(args)
+        workers = max(2, args.concurrency)
+
+        def worker(w):
+            for kind, payload in shuffled[w::workers]:
+                tid = tracectx.new_trace_id()
+                t_req = time.monotonic()
+                try:
+                    status, body = fire(payload, tid=tid)
+                except Exception:
+                    status, body = 599, {}
+                wall = time.monotonic() - t_req
+                gen = max(0, len(body.get("tokens", ()))
+                          - len(payload["tokens"])) if status == 200 else 0
+                with lock:
+                    results.append((kind, wall, status, gen, tid))
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(workers)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t0
+        transfer = {
+            "migrations": _scrape_labeled(router_port,
+                                          "hbnlp_disagg_migrations_total"),
+            "index": _scrape_labeled(router_port,
+                                     "hbnlp_disagg_index_total"),
+            "transfer_bytes": _scrape_values(
+                router_port,
+                ("hbnlp_disagg_transfer_bytes_total",))
+            ["hbnlp_disagg_transfer_bytes_total"],
+        }
+        flight.flush(reason=f"bench-disagg-{label}")
+    finally:
+        fleet.stop()
+
+    # merged per-hop rows (forensics --trace form): router blackbox
+    # (router/forward + kv_transfer spans) + replica blackboxes + the
+    # replicas' per-request trace exports, all under one scratch dir
+    fz = _load_forensics()
+    files = fz.load_files(fz.discover(scratch))
+    per_hop, traced = {}, 0
+    for kind, wall_r, status, gen, tid in results:
+        rep = fz.trace_report(files, tid, scratch)
+        hops = dict(rep["hops"])
+        for k, v in ((rep.get("exported") or {}).get("hops") or {}).items():
+            hops.setdefault(k, v)
+        if hops:
+            traced += 1
+        for k, v in hops.items():
+            per_hop.setdefault(k, []).append(v)
+    hops_row = {"traced_requests": traced}
+    for k, vals in sorted(per_hop.items()):
+        hops_row[k] = {"p50": round(float(np.percentile(vals, 50)), 6),
+                       "p99": round(float(np.percentile(vals, 99)), 6),
+                       "n": len(vals)}
+
+    errors = {}
+    for kind, wall_r, status, gen, tid in results:
+        if status != 200:
+            errors[str(status)] = errors.get(str(status), 0) + 1
+    ttfts = sorted(w for kind, w, status, gen, tid in results
+                   if kind == "probe" and status == 200)
+    gen_total = sum(gen for _, _, status, gen, _ in results if status == 200)
+    return {
+        "classes": ",".join(classes) if classes else "symmetric",
+        "requests_ok": sum(1 for r in results if r[2] == 200),
+        "errors": errors,
+        "generated_tokens": gen_total,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(gen_total / max(wall, 1e-9), 2),
+        "ttft_p50": round(float(np.percentile(ttfts, 50)), 4) if ttfts
+        else None,
+        "ttft_p99": round(float(np.percentile(ttfts, 99)), 4) if ttfts
+        else None,
+        "ttft_samples": len(ttfts),
+        "canary": (canary_a.get("tokens"), canary_b.get("tokens")),
+        "hops": hops_row,
+        "transfer": transfer,
+    }
+
+
+def run_disagg(args) -> dict:
+    sym = _run_disagg_tier("symmetric", None, args, DISAGG_TOKEN_WAIT_S)
+    print(json.dumps({"disagg_symmetric_tier": sym}), flush=True)
+    dis = _run_disagg_tier("classes", DISAGG_CLASSES, args,
+                           DISAGG_TOKEN_WAIT_S)
+    print(json.dumps({"disagg_class_tier": dis}), flush=True)
+    canaries = [sym["canary"][0], sym["canary"][1],
+                dis["canary"][0], dis["canary"][1]]
+    parity = all(c == canaries[0] and c is not None for c in canaries)
+    sym_row = {k: v for k, v in sym.items() if k != "canary"}
+    dis_row = {k: v for k, v in dis.items() if k != "canary"}
+    return {
+        "mode": "disagg",
+        "replicas": len(DISAGG_CLASSES),
+        "device_token_wait_s": DISAGG_TOKEN_WAIT_S,
+        "host_cores": os.cpu_count(),
+        "note": ("compute-bound device emulation (sleep per token each "
+                 "dispatch advanced) like the replicas row — the tier "
+                 "topology, not the single host core, sets wall time; "
+                 "every session prompt shares a 32-token system head, the "
+                 "regime where the symmetric tier's affinity key "
+                 "collides and overload spills duplicate cold prefills "
+                 "while the global prefix index stays block-exact; "
+                 "silicon re-measure queued on the tunnel"),
+        "workload": {
+            "families": DISAGG_FAMILIES,
+            "prefix_tokens": DISAGG_PREFIX_TOKENS,
+            "shared_head_tokens": DISAGG_SHARED_HEAD,
+            "hit_probes": DISAGG_FAMILIES * DISAGG_HITS_PER_FAMILY,
+            "in_window_migration_probes": 3,
+            "decode_heavy": DISAGG_DECODE_HEAVY,
+            "cold_newcomers": DISAGG_NEWCOMERS,
+        },
+        "canary_parity": parity,
+        "symmetric": sym_row,
+        "disagg": dis_row,
+        "tokens_per_sec_ratio": round(
+            dis["tokens_per_sec"] / max(sym["tokens_per_sec"], 1e-9), 3),
+        "ttft_p99_ratio": round(
+            (dis["ttft_p99"] or 1e9) / max(sym["ttft_p99"] or 1e-9, 1e-9),
+            3),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--engines", default="batch,continuous",
@@ -1178,6 +1565,15 @@ def main(argv=None) -> int:
                          "prefix-hit vs cold TTFT in the SAME serving "
                          "process, at greedy bit-parity (docs/SERVING.md "
                          "'Engine architecture')")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode tier A/B: "
+                         "prefill:1,decode:2 classes (KV-block streaming + "
+                         "router global prefix index) vs the symmetric "
+                         "3-replica tier at equal count, on a mixed "
+                         "long-prefill/long-decode workload; records "
+                         "aggregate tokens/sec, p99 TTFT, and merged "
+                         "per-hop rows including the kv_transfer hop "
+                         "(docs/SERVING.md 'Disaggregated tier')")
     ap.add_argument("--replicas", type=int, default=0,
                     help="multi-replica tier scaling sweep up to N "
                          "replicas behind the router (device-wait "
@@ -1269,6 +1665,41 @@ def main(argv=None) -> int:
                 failures.append("no prefix hits recorded")
             if result["spec"]["drafted"] <= 0:
                 failures.append("no draft tokens recorded")
+        if failures:
+            print("CHECK FAILED: " + "; ".join(failures), flush=True)
+            return 1
+        return 0
+
+    if args.disagg:
+        result = run_disagg(args)
+        merge_out("disagg", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "note"}), flush=True)
+        failures = []
+        if args.check:
+            if not result["canary_parity"]:
+                failures.append("disagg canary diverged (streamed-block "
+                                "answers must be bit-identical to the "
+                                "symmetric tier's)")
+            if result["tokens_per_sec_ratio"] <= 1.0:
+                failures.append(
+                    f"disagg tokens/sec ratio "
+                    f"{result['tokens_per_sec_ratio']} <= 1.0x symmetric")
+            if result["ttft_p99_ratio"] >= 1.0:
+                failures.append(
+                    f"disagg p99 TTFT ratio {result['ttft_p99_ratio']} "
+                    ">= 1.0x symmetric")
+            kv_hop = result["disagg"]["hops"].get("kv_transfer") or {}
+            if not kv_hop.get("n"):
+                failures.append("no kv_transfer hop spans in the merged "
+                                "disagg trace")
+            if result["disagg"]["errors"] or result["symmetric"]["errors"]:
+                failures.append(
+                    f"request errors: disagg={result['disagg']['errors']} "
+                    f"symmetric={result['symmetric']['errors']}")
+            if not result["disagg"]["transfer"]["migrations"].get(
+                    'outcome="ok"'):
+                failures.append("no successful block migrations recorded")
         if failures:
             print("CHECK FAILED: " + "; ".join(failures), flush=True)
             return 1

@@ -17,7 +17,8 @@ cd "$(dirname "$0")/.."
 MARKERS=("$@")
 if [ ${#MARKERS[@]} -eq 0 ]; then
   MARKERS=(serving contbatch distributed specdecode specpaged
-           staticanalysis attribution pagedkv router elastic forensics)
+           staticanalysis attribution pagedkv router elastic forensics
+           disagg)
 fi
 PER_SUITE_TIMEOUT="${LATE_MARKER_TIMEOUT:-900}"
 # the elastic suite runs two full controller e2es (multiple jax fleet

@@ -377,7 +377,10 @@ def engine_rest_roundtrip_test():
     try:
         status, health = post("/health", {})
         assert status == 200
-        assert health["engine"] == {"mode": "continuous", "slots": 4}
+        eng = health["engine"]
+        assert eng["mode"] == "continuous" and eng["slots"] == 4
+        assert eng["program"] == "engine_chunk_step"
+        assert eng["replica_class"] == "" and eng["kv_transfer"] is False
         results = {}
 
         def bg(name, payload):

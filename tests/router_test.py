@@ -188,6 +188,83 @@ def router_health_merge_test():
     assert payload["status"] == "unavailable"
 
 
+# ---------------------------------------------------- disagg owner failover
+
+def kill_the_owner_degrades_to_cold_prefill_test():
+    """Disaggregated tier, owner death mid-traffic: when the global prefix
+    index names an owner that is GONE (connection refused) or breaker-open,
+    the request falls back to cold prefill on another replica, the stale
+    index entries are invalidated, and the client gets EXACTLY one answer
+    — never a 500, never a duplicate."""
+    from homebrewnlp_tpu.infer.router import KV_BLOCKS_PATH
+
+    answered = []                            # successful completion answers
+    dead = set()
+
+    def transport(replica, path, body, timeout, headers=None):
+        if replica.index in dead:
+            raise ConnectionRefusedError(f"replica {replica.index} killed")
+        if path == KV_BLOCKS_PATH:
+            if body.get("op") == "export":
+                toks = body["tokens"]
+                return 200, {"version": 1, "block_tokens": 4,
+                             "blocks": [{"key": toks[i:i + 4],
+                                         "leaves": {"t/k": {"bytes": 8}}}
+                                        for i in range(0, len(toks), 4)]}
+            return 200, {"injected": 1, "skipped": 0}
+        answered.append(replica.index)
+        return 200, {"tokens": [9], "replica": replica.index}
+
+    t = [0.0]
+    reps = [Replica(i, 9000 + i, breaker_threshold=2, breaker_cooldown_s=5.0,
+                    clock=lambda: t[0]) for i in range(3)]
+    router = Router(reps, transport=transport, clock=lambda: t[0],
+                    classes=["prefill", "decode", "decode"], block_tokens=4)
+    toks = list(range(1, 10))                # 2 whole blocks + 1
+    # warm: cold run lands on the prefill replica, migration hands the
+    # blocks (and ownership) to a decode replica
+    router.forward("/token_completion", {"tokens": toks})
+    out = router.forward("/token_completion", {"tokens": toks})
+    owner = out["replica"]
+    assert reps[owner].cls == "decode"
+    assert router.gindex.lookup(toks)[0] == owner
+    # KILL the owner: the very next request must still answer, exactly once
+    dead.add(owner)
+    answered.clear()
+    out = router.forward("/token_completion", {"tokens": toks})
+    assert out["replica"] != owner
+    assert answered == [out["replica"]]      # exactly-one-answer invariant
+    # stale entries dropped and ownership re-learned on the survivor
+    assert router.gindex.lookup(toks)[0] == out["replica"]
+    assert all(v != owner for v in router.gindex._map.values())
+    # breaker-open owner (not yet dead at the transport level) also
+    # degrades without a transport call reaching it
+    victim = out["replica"]
+    for _ in range(2):
+        reps[victim].breaker.record_failure()
+    assert reps[victim].breaker.tick() == "open"
+    answered.clear()
+    out = router.forward("/token_completion", {"tokens": toks})
+    assert out["replica"] != victim and answered == [out["replica"]]
+    assert all(v != victim for v in router.gindex._map.values())
+
+
+def symmetric_tier_never_consults_kv_blocks_test():
+    """Classless (or single-class) replica lists leave the global index
+    off: forward() is byte-identical to the pre-disagg router."""
+    from homebrewnlp_tpu.infer.router import KV_BLOCKS_PATH
+    paths = []
+
+    def transport(replica, path, body, timeout, headers=None):
+        paths.append(path)
+        return 200, {"ok": replica.index}
+
+    router, _, _ = _router(transport=transport)
+    assert router.gindex is None
+    router.forward("/token_completion", {"tokens": list(range(12))})
+    assert KV_BLOCKS_PATH not in paths
+
+
 # ------------------------------------------------------------ fleet stubs
 
 def _stub_replica_ok(cfg, port, index):
