@@ -1,0 +1,824 @@
+"""Deterministic interleaving explorer (graft-lint ``--conc``, half 2).
+
+The serving/elastic control plane is host Python threads mutating shared
+state machines (docs/STATIC_ANALYSIS.md 'Concurrency audit'); its
+correctness claims — exactly-one-answer, refcount conservation, half-open
+single probe, owner-death-never-500s, generation monotonicity — are
+schedule-dependent, and pytest's real-thread races reproduce one schedule
+per run at the OS scheduler's whim.  This module makes schedules a TEST
+INPUT:
+
+* :class:`Explorer` — a cooperative scheduler over real threads where
+  exactly ONE logical task runs at a time and control changes hands only
+  at explicit switch points, chosen by a seeded RNG.  Same seed + same
+  task code => byte-identical schedule (``Explorer.trace``).
+* :class:`ExploredLock` — a lock whose acquire/release are switch points
+  (preemption injected at every lock boundary).  Reentrant when built via
+  ``Explorer.rlock``.  Tasks blocked on a held lock are scheduled only
+  when it frees; a state where every live task is blocked raises
+  :class:`DeadlockError` naming the wait cycle.
+* ``wrap_lock(explorer, obj, attr)`` — swap a real ``threading.Lock`` /
+  ``RLock`` attribute for an explored one, so production classes run
+  under the explorer unmodified.
+* ``instrument(explorer, obj, methods)`` — add switch points at method
+  entry/exit for lock-free state machines (BlockPool, CircuitBreaker),
+  whose linearization points are their method boundaries.
+* :data:`SCENARIOS` — the repo's named invariants, each driven under
+  permuted schedules; ``run_scenarios`` returns violations as findings
+  for the ``--conc`` CLI.
+
+Device-free: everything here is stdlib + numpy; scenario harnesses
+lazy-import their subjects (``infer.paged`` pulls the engine stack).
+
+The explorer's observed lock-order edges (``Explorer.order_edges``) feed
+the same cycle checker as the static graph and the runtime traces
+(``analysis/conc_lint.py``), so all three views cross-validate.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import typing
+
+__all__ = [
+    "DeadlockError", "ExplorationLimit", "Explorer", "ExploredLock",
+    "VirtualClock", "wrap_lock", "instrument", "SCENARIOS",
+    "run_scenarios",
+]
+
+
+class DeadlockError(AssertionError):
+    """Every live task is blocked on a lock: the explorer found a real
+    deadlock.  ``waiters`` is ``[(task, lock, holder), ...]``; ``trace``
+    the schedule that reached it."""
+
+    def __init__(self, message: str, waiters=(), trace=()):
+        super().__init__(message)
+        self.waiters = list(waiters)
+        self.trace = list(trace)
+
+
+class ExplorationLimit(RuntimeError):
+    """The schedule exceeded ``max_switches`` — a livelock (or a scenario
+    that genuinely needs a bigger budget)."""
+
+
+class _TaskAbort(BaseException):
+    """Unwinds abandoned task threads on teardown; never escapes."""
+
+
+class VirtualClock:
+    """Injectable monotonic clock: the scheduler advances it one ``tick``
+    per context switch, so timeouts and deadlines are schedule-
+    deterministic.  Callable, so it drops into every ``clock=`` seam."""
+
+    def __init__(self, start: float = 0.0, tick: float = 0.001):
+        self._now = float(start)
+        self.tick = float(tick)
+
+    def now(self) -> float:
+        return self._now
+
+    __call__ = now
+
+    def advance(self, dt: typing.Optional[float] = None) -> float:
+        self._now += self.tick if dt is None else float(dt)
+        return self._now
+
+
+class _Task:
+    __slots__ = ("name", "fn", "state", "waiting_on", "thread", "error",
+                 "held")
+
+    def __init__(self, name: str, fn: typing.Callable[[], None]):
+        self.name = name
+        self.fn = fn
+        self.state = "new"      # new -> ready -> running -> blocked/done
+        self.waiting_on: typing.Optional["ExploredLock"] = None
+        self.thread: typing.Optional[threading.Thread] = None
+        self.error: typing.Optional[BaseException] = None
+        self.held: typing.List["ExploredLock"] = []
+
+
+class ExploredLock:
+    """Mutex whose boundaries are preemption points.  Only valid inside a
+    running exploration; outside one (``current task is None``) it
+    degrades to no-op bookkeeping so wrapped objects stay importable."""
+
+    def __init__(self, explorer: "Explorer", name: str,
+                 reentrant: bool = False):
+        self._ex = explorer
+        self.name = name
+        self.reentrant = reentrant
+        self._owner: typing.Optional[_Task] = None
+        self._depth = 0
+
+    def _available_to(self, task: _Task) -> bool:
+        return self._owner is None or (self.reentrant
+                                       and self._owner is task)
+
+    def acquire(self) -> bool:
+        ex = self._ex
+        task = ex._current_task()
+        if task is None:
+            return True
+        ex._switch(task, f"acquire:{self.name}")
+        while not self._available_to(task):
+            ex._block(task, self)
+        if self._owner is task:
+            self._depth += 1
+            return True
+        self._owner = task
+        self._depth = 1
+        # observed ordering edges: every lock already held at this acquire
+        # is an outer lock of this one (fed to the conc_lint cycle checker)
+        for outer in task.held:
+            if outer is not self:
+                ex.order_edges.add((outer.name, self.name))
+        task.held.append(self)
+        return True
+
+    def release(self) -> None:
+        ex = self._ex
+        task = ex._current_task()
+        if task is None:
+            return
+        if self._owner is not task:
+            raise RuntimeError(f"task {task.name!r} released "
+                               f"{self.name!r} it does not hold")
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+            task.held.remove(self)
+        ex._switch(task, f"release:{self.name}")
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+
+class Explorer:
+    """Seed-reproducible cooperative scheduler.
+
+    Register tasks with ``task(fn, name)``, then ``run()``.  Tasks are
+    real threads, but exactly one executes between switch points; at each
+    point the scheduler picks the next runnable task with its seeded RNG,
+    appending to ``trace``.  A task exception aborts the run and re-raises
+    in the caller; all-blocked raises :class:`DeadlockError`.
+    """
+
+    def __init__(self, seed: int = 0, max_switches: int = 200_000,
+                 clock: typing.Optional[VirtualClock] = None):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self.max_switches = int(max_switches)
+        self.clock = clock if clock is not None else VirtualClock()
+        self.trace: typing.List[str] = []
+        self.order_edges: typing.Set[typing.Tuple[str, str]] = set()
+        self._tasks: typing.List[_Task] = []
+        self._cv = threading.Condition()
+        self._running: typing.Optional[_Task] = None
+        self._abort = False
+        self._locals = threading.local()
+
+    # -- construction --------------------------------------------------------
+
+    def task(self, fn: typing.Callable[[], None],
+             name: typing.Optional[str] = None) -> _Task:
+        t = _Task(name or f"task{len(self._tasks)}", fn)
+        self._tasks.append(t)
+        return t
+
+    def lock(self, name: str) -> ExploredLock:
+        return ExploredLock(self, name)
+
+    def rlock(self, name: str) -> ExploredLock:
+        return ExploredLock(self, name, reentrant=True)
+
+    # -- task-side switch points ---------------------------------------------
+
+    def _current_task(self) -> typing.Optional[_Task]:
+        return getattr(self._locals, "task", None)
+
+    def step(self, label: str = "") -> None:
+        """Voluntary preemption point (harness code calls this directly;
+        locks and ``instrument`` call it for production code)."""
+        task = self._current_task()
+        if task is not None:
+            self._switch(task, label)
+
+    def _switch(self, task: _Task, label: str) -> None:
+        with self._cv:
+            task.state = "ready"
+            self._running = None
+            self._cv.notify_all()
+            self._cv.wait_for(
+                lambda: self._running is task or self._abort)
+            if self._abort:
+                raise _TaskAbort()
+            task.state = "running"
+
+    def _block(self, task: _Task, lock: ExploredLock) -> None:
+        with self._cv:
+            task.state = "blocked"
+            task.waiting_on = lock
+            self._running = None
+            self._cv.notify_all()
+            self._cv.wait_for(
+                lambda: self._running is task or self._abort)
+            if self._abort:
+                raise _TaskAbort()
+            task.state = "running"
+            task.waiting_on = None
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _runner(self, task: _Task) -> None:
+        self._locals.task = task
+        try:
+            with self._cv:
+                task.state = "ready"
+                self._cv.notify_all()
+                self._cv.wait_for(
+                    lambda: self._running is task or self._abort)
+                if self._abort:
+                    raise _TaskAbort()
+                task.state = "running"
+            task.fn()
+        except _TaskAbort:
+            return
+        except BaseException as e:  # noqa: BLE001 — re-raised in run()
+            task.error = e
+        finally:
+            with self._cv:
+                task.state = "done"
+                if self._running is task:
+                    self._running = None
+                self._cv.notify_all()
+
+    def _runnable(self) -> typing.List[_Task]:
+        out = []
+        for t in self._tasks:
+            if t.state == "ready":
+                out.append(t)
+            elif t.state == "blocked" and t.waiting_on._available_to(t):
+                out.append(t)
+        return out
+
+    def _schedulable(self) -> bool:
+        if any(t.error is not None for t in self._tasks):
+            return True
+        if all(t.state == "done" for t in self._tasks):
+            return True
+        if any(t.state == "new" for t in self._tasks):
+            # a thread has not reached its first wait yet — keep waiting
+            return False
+        return True  # someone is ready/blocked: pick or declare deadlock
+
+    def run(self) -> "Explorer":
+        for t in self._tasks:
+            t.thread = threading.Thread(
+                target=self._runner, args=(t,), daemon=True,
+                name=f"interleave-{t.name}")
+            t.thread.start()
+        try:
+            switches = 0
+            while True:
+                with self._cv:
+                    self._cv.wait_for(
+                        lambda: self._running is None
+                        and self._schedulable())
+                    err = next((t for t in self._tasks
+                                if t.error is not None), None)
+                    if err is not None:
+                        raise err.error
+                    if all(t.state == "done" for t in self._tasks):
+                        return self
+                    ready = self._runnable()
+                    if not ready:
+                        waiters = [(t.name, t.waiting_on.name,
+                                    t.waiting_on._owner.name
+                                    if t.waiting_on._owner else "?")
+                                   for t in self._tasks
+                                   if t.state == "blocked"]
+                        chain = "; ".join(
+                            f"{t} waits on {l} held by {h}"
+                            for t, l, h in waiters)
+                        raise DeadlockError(
+                            f"deadlock under seed {self.seed}: {chain}",
+                            waiters=waiters, trace=self.trace)
+                    switches += 1
+                    if switches > self.max_switches:
+                        raise ExplorationLimit(
+                            f"schedule exceeded {self.max_switches} "
+                            f"switches under seed {self.seed}")
+                    choice = ready[self._rng.randrange(len(ready))]
+                    self.trace.append(choice.name)
+                    self.clock.advance()
+                    self._running = choice
+                    self._cv.notify_all()
+        finally:
+            with self._cv:
+                self._abort = True
+                self._running = None
+                self._cv.notify_all()
+            for t in self._tasks:
+                if t.thread is not None:
+                    t.thread.join(timeout=5.0)
+
+
+# -- adapters for production classes -----------------------------------------
+
+def wrap_lock(explorer: Explorer, obj, attr: str = "_lock",
+              name: typing.Optional[str] = None) -> ExploredLock:
+    """Replace ``obj.<attr>`` (a ``threading.Lock``/``RLock``) with an
+    explored lock so preemption lands at the object's real lock
+    boundaries."""
+    current = getattr(obj, attr)
+    reentrant = isinstance(current, type(threading.RLock()))
+    lock = ExploredLock(
+        explorer, name or f"{type(obj).__name__}.{attr}", reentrant)
+    setattr(obj, attr, lock)
+    return lock
+
+
+def instrument(explorer: Explorer, obj,
+               methods: typing.Sequence[str]) -> None:
+    """Wrap ``obj``'s methods with entry/exit switch points — the
+    preemption seam for LOCK-FREE state machines, whose linearization
+    points are their (single-threaded-by-contract) method boundaries."""
+    for m in methods:
+        fn = getattr(obj, m)
+
+        def wrapped(*a, __fn=fn, __m=m, **kw):
+            explorer.step(f"enter:{__m}")
+            try:
+                return __fn(*a, **kw)
+            finally:
+                explorer.step(f"exit:{__m}")
+
+        setattr(obj, m, wrapped)
+
+
+# ============================================================================
+# Scenario library: the repo's named invariants under permuted schedules.
+# Each scenario takes a seed, runs one exploration, and raises
+# AssertionError (message includes the seed + trace tail) on violation.
+# ============================================================================
+
+def _fail(explorer: Explorer, message: str) -> typing.NoReturn:
+    tail = ",".join(explorer.trace[-12:])
+    raise AssertionError(f"{message} [seed={explorer.seed} "
+                         f"trace_tail={tail}]")
+
+
+def scenario_engine_exactly_one_answer(seed: int) -> None:
+    """SlotScheduler/EngineController: every submitted request leaves via
+    exactly one ``answer`` outcome — across interleaved submits, deadline
+    expiry, a failing dispatch, and an open->half_open breaker window —
+    and half-open admits exactly one probe into an empty slot set."""
+    import numpy as np
+
+    from ..infer.scheduler import EngineController, EngineRequest, \
+        SlotScheduler
+
+    ex = Explorer(seed)
+    clock = ex.clock
+
+    class _Exec:
+        """Deterministic fake executor: advances every live slot one
+        position per step; dispatch #3 raises (the device-fault path)."""
+
+        slots, seq = 4, 16
+
+        def __init__(self):
+            self.q = np.zeros(self.slots, np.int64)
+            self.dispatches = 0
+
+        def admit(self, slot, req):
+            self.q[slot] = 0
+
+        def release(self, slot):
+            self.q[slot] = 0
+
+        def reset(self):
+            self.q[:] = 0
+
+        def tokens(self, slot):
+            return [7] * int(self.q[slot])
+
+        def dispatch(self, steps):
+            ex.step("dispatch")
+            self.dispatches += 1
+            if self.dispatches == 3:
+                raise RuntimeError("injected device fault")
+            self.q = self.q + 1
+            return self.q.copy()
+
+    class _Guard:
+        """Minimal guard seam: a breaker that opens on the injected fault
+        and half-opens one virtual second later."""
+
+        def __init__(self):
+            from ..infer.serving_guard import CircuitBreaker
+            self.breaker = CircuitBreaker(threshold=1, cooldown_s=0.005,
+                                          clock=clock)
+
+        def record_decode_failure(self):
+            self.breaker.record_failure()
+
+        def record_decode_success(self):
+            self.breaker.record_success()
+
+    answered: typing.Dict[str, typing.List[str]] = {}
+
+    def answer(req, outcome):
+        answered.setdefault(req.rid, []).append(outcome[0])
+
+    sched = SlotScheduler(4, clock=clock)
+    guard = _Guard()
+    ctl = EngineController(_Exec(), sched, guard=guard, clock=clock,
+                           decode_chunk=4, answer=answer)
+    instrument(ex, sched, ("submit", "admit", "expire", "finish"))
+
+    submitted: typing.List[str] = []
+
+    def producer(tag: str, n: int):
+        def fn():
+            for i in range(n):
+                rid = f"{tag}{i}"
+                deadline = clock() + 0.5 if i % 3 else clock() + 0.002
+                sched.submit(EngineRequest(
+                    rid=rid, path="/token_completion", toks=[1, 2, 3],
+                    response_len=2, deadline=deadline))
+                submitted.append(rid)
+                ex.step("submitted")
+        return fn
+
+    def device_loop():
+        for _ in range(40):
+            ctl.round()
+            ex.step("round")
+            clock.advance(0.002)
+        # drain: give the breaker time to half-open, then finish the rest
+        clock.advance(0.01)
+        for _ in range(60):
+            if sched.depth() == 0:
+                break
+            # half-open single probe: an empty slot set may admit at most
+            # one request while the breaker probes
+            if guard.breaker.tick() == "half_open" \
+                    and not sched.resident:
+                before = len(sched.resident)
+                ctl.round()
+                if len(sched.resident) - before > 1:
+                    _fail(ex, "half-open admitted "
+                          f"{len(sched.resident) - before} probes")
+            else:
+                ctl.round()
+            clock.advance(0.002)
+
+    ex.task(producer("a", 5), "producer-a")
+    ex.task(producer("b", 5), "producer-b")
+    ex.task(device_loop, "device-loop")
+    ex.run()
+    for rid in submitted:
+        n = len(answered.get(rid, ()))
+        if n != 1:
+            _fail(ex, f"request {rid} answered {n} times "
+                  f"(outcomes={answered.get(rid)}) — exactly-one-answer "
+                  "violated")
+    return ex
+
+
+def scenario_router_owner_death_never_500(seed: int) -> None:
+    """Router + GlobalPrefixIndex + CircuitBreaker under concurrent
+    forwards, an owner dying mid-run, and the poll loop's
+    ``sync_global_index`` racing the invalidate: clients only ever see
+    classified HTTPStatusError payloads (never an unhandled exception),
+    and a digest fetched BEFORE ``invalidate_owner`` cannot resurrect the
+    dead owner's entries (the owner-generation guard)."""
+    from ..infer.router import GlobalPrefixIndex, Replica, Router
+    from ..infer.serving_guard import HTTPStatusError
+
+    ex = Explorer(seed)
+    clock = ex.clock
+    dead = {"idx": None}
+
+    def transport(replica, path, body, timeout, headers=None):
+        ex.step(f"transport:{replica.index}:{body.get('op', 'fwd')}")
+        if replica.index == dead["idx"]:
+            return 500, {"error": "replica crashed"}
+        if body.get("op") == "index":
+            # replica 1's digest names its cached blocks — computed at
+            # fetch time, absorbed later (the race window under test)
+            paths = [[1, 2, 3, 4]] if replica.index == 1 else []
+            digest = {"block_tokens": 4, "paths": paths}
+            ex.step("index-fetched")
+            return 200, digest
+        if body.get("op") in ("export", "import"):
+            return 503, {"error": "no blocks"}
+        return 200, {"tokens": [9], "text": "ok"}
+
+    reps = [Replica(i, 9000 + i, clock=clock, breaker_cooldown_s=0.5)
+            for i in range(3)]
+    router = Router(reps, transport=transport, clock=clock,
+                    classes=["prefill", "decode", "decode"],
+                    block_tokens=4)
+    wrap_lock(ex, router.gindex, "_lock", "GlobalPrefixIndex._lock")
+    wrap_lock(ex, router, "_lock", "Router._lock")
+    for r in reps:
+        wrap_lock(ex, r, "_lock", f"Replica{r.index}._lock")
+        instrument(ex, r.breaker, ("tick", "record_failure",
+                                   "record_success"))
+
+    # seed ownership: replica 1 (decode) owns the probe prefix
+    router.gindex.record([1, 2, 3, 4], 1)
+    errors: typing.List[BaseException] = []
+
+    def client(tag: str):
+        def fn():
+            for i in range(4):
+                if tag == "a" and i == 1:
+                    dead["idx"] = 1  # owner dies under concurrent load
+                try:
+                    router.forward("/token_completion",
+                                   {"tokens": [1, 2, 3, 4, 5]})
+                except HTTPStatusError:
+                    pass  # classified degradation is the contract
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                ex.step("answered")
+        return fn
+
+    def poller():
+        for _ in range(3):
+            router.sync_global_index(force=True)
+            ex.step("synced")
+
+    ex.task(client("a"), "client-a")
+    ex.task(client("b"), "client-b")
+    ex.task(poller, "poller")
+    ex.run()
+    if errors:
+        _fail(ex, "owner death surfaced an unclassified error to a "
+              f"client: {errors[0]!r} — never-a-500 violated")
+    if dead["idx"] is not None:
+        owner, _ = router.gindex.lookup([1, 2, 3, 4])
+        if owner == dead["idx"]:
+            _fail(ex, f"dead replica {dead['idx']} still owns prefix "
+                  "entries after invalidate — a stale index digest "
+                  "resurrected it (sync-vs-invalidate race)")
+    return ex
+
+
+def scenario_blockpool_refcount_conservation(seed: int) -> None:
+    """BlockPool + RadixIndex: under interleaved alloc/share/release/evict
+    from two request streams, free + live + cached partitions the pool at
+    every boundary, and the index never holds a freed block."""
+    from ..infer.paged import BlockPool, RadixIndex
+
+    ex = Explorer(seed)
+    pool = BlockPool(8)
+    index = RadixIndex(4)
+    # composite ops (lookup+addref, alloc+insert, deref+maybe-reclaim,
+    # evict) are atomic in the product — the device loop is one thread —
+    # so the harness serializes them under one lock and the explorer
+    # permutes the ORDER of critical sections across streams
+    pool_lock = ex.lock("pool")
+    instrument(ex, pool, ("alloc", "addref", "deref", "reclaim"))
+
+    def check():
+        free = pool.free_count
+        live = pool.live_count
+        cached = sum(1 for b in range(pool.num_blocks)
+                     if not pool._on_free[b] and pool.refcount(b) == 0)
+        if free + live + cached != pool.num_blocks:
+            _fail(ex, f"free({free}) + live({live}) + cached({cached}) "
+                  f"!= {pool.num_blocks} — pool partition violated")
+        for b, node in index._by_block.items():
+            if pool._on_free[b]:
+                _fail(ex, f"radix index holds FREED block {b}")
+
+    def stream(base: int):
+        def fn():
+            toks = [base, base + 1, base + 2, base + 3]
+            for _ in range(6):
+                with pool_lock:
+                    full, _, _ = index.lookup(toks)
+                    if full:
+                        held = full[-1].block
+                        pool.addref(held)
+                    else:
+                        if pool.free_count == 0 \
+                                and not index.evict_lru(pool):
+                            check()
+                            continue
+                        held = pool.alloc()
+                        index.insert(None, tuple(toks), held)
+                    check()
+                ex.step("hold")
+                with pool_lock:
+                    if pool.deref(held) == 0 \
+                            and not index.holds(held):
+                        pool.reclaim(held)
+                    check()
+        return fn
+
+    def evictor():
+        for _ in range(4):
+            with pool_lock:
+                index.evict_lru(pool)
+                check()
+            ex.step("evicted")
+
+    ex.task(stream(10), "stream-a")
+    ex.task(stream(20), "stream-b")
+    ex.task(evictor, "evictor")
+    ex.run()
+    check()
+    return ex
+
+
+def scenario_elastic_generation_monotonicity(seed: int) -> None:
+    """ElasticAgent lease scans: a stale previous-generation publisher can
+    never satisfy the current generation's liveness scan (lease keys embed
+    the generation), a live peer is never reported lapsed while it keeps
+    beating, and a recorded membership event never un-happens."""
+    import tempfile
+
+    from ..distributed.elastic import ElasticAgent
+
+    ex = Explorer(seed)
+    clock = ex.clock
+    kv: typing.Dict[str, str] = {}
+    kv_lock = ex.lock("kv")
+
+    def kv_put(key, value):
+        with kv_lock:
+            kv[key] = value
+        return True
+
+    def kv_dir_get(prefix):
+        with kv_lock:
+            return [(k, v) for k, v in kv.items()
+                    if k.startswith(prefix)]
+
+    class _Rec:
+        def record(self, kind, **fields):
+            return {}
+
+        def flush(self, reason=""):
+            return None
+
+    tmp = tempfile.mkdtemp(prefix="hbnlp-conc-elastic-")
+
+    def agent(pid):
+        return ElasticAgent(
+            tmp, pid, 2, gen=1, interval_s=0.01, timeout_s=0.05,
+            kv_put=kv_put, kv_dir_get=kv_dir_get, clock=clock,
+            exit_fn=lambda code: None, recorder=_Rec())
+
+    a0, a1 = agent(0), agent(1)
+    a0._started_at = a1._started_at = clock()
+    saw_event = {0: None, 1: None}
+
+    def beat(agent_, pid, ticks, then_stop_at=None):
+        def fn():
+            for i in range(ticks):
+                if then_stop_at is not None and i >= then_stop_at:
+                    break  # this rank dies: stops publishing
+                agent_.tick()
+                if agent_.event is not None and saw_event[pid] is None:
+                    saw_event[pid] = agent_.event
+                if saw_event[pid] is not None and agent_.event is None:
+                    _fail(ex, f"rank {pid}'s membership event "
+                          "un-happened — monotonicity violated")
+                ex.step("beat")
+                clock.advance(0.004)
+        return fn
+
+    def stale_gen_publisher():
+        # a leftover generation-0 process keeps publishing under its OLD
+        # keys: it must be invisible to the generation-1 scan
+        for i in range(8):
+            kv_put("hbnlp/elastic/g0/p1", '{"seq": %d}' % (1000 + i))
+            ex.step("stale-beat")
+            clock.advance(0.004)
+
+    ex.task(beat(a0, 0, 24), "rank0")
+    ex.task(beat(a1, 1, 24, then_stop_at=8), "rank1")
+    ex.task(stale_gen_publisher, "stale-gen0")
+    ex.run()
+    # rank1 stopped beating: rank0 must have detected the lapse (the
+    # stale g0 lease for p1 must NOT have kept it alive)
+    if a0.event is None:
+        _fail(ex, "rank 1 stopped beating but rank 0 never recorded a "
+              "membership event — the stale generation-0 lease kept a "
+              "dead peer alive (generation monotonicity violated)")
+    if 1 not in a0.lapsed:
+        _fail(ex, f"rank 0 lapsed={a0.lapsed} does not name rank 1")
+    return ex
+
+
+def scenario_flight_recorder_flush(seed: int) -> None:
+    """RotatingJsonl/FlightRecorder: concurrent ``record`` from two tasks
+    racing ``flush``: seq is strictly increasing and dense, flush holds
+    the lock only for the ring copy (file IO runs outside), and the
+    flushed blackbox parses as JSONL whose events are a suffix of what
+    was recorded."""
+    import json
+    import os
+    import tempfile
+
+    from ..telemetry.events import FlightRecorder, blackbox_path
+
+    ex = Explorer(seed)
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = FlightRecorder(capacity=64, clock=ex.clock,
+                             wall=ex.clock)
+        rec.configure(tmp, "conc")
+        wrap_lock(ex, rec, "_lock", "FlightRecorder._lock")
+
+        def writer(tag, n):
+            def fn():
+                for i in range(n):
+                    rec.record("tick", src=tag, i=i)
+                    ex.step("recorded")
+            return fn
+
+        def flusher():
+            for _ in range(4):
+                rec.flush(reason="probe")
+                ex.step("flushed")
+
+        ex.task(writer("a", 8), "writer-a")
+        ex.task(writer("b", 8), "writer-b")
+        ex.task(flusher, "flusher")
+        ex.run()
+        events = rec.events()
+        seqs = [e["seq"] for e in events]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            _fail(ex, f"ring seq not strictly increasing: {seqs}")
+        if len(events) != 16:
+            _fail(ex, f"lost update: {len(events)}/16 events survived "
+                  "concurrent record()")
+        path = blackbox_path(tmp, "conc")
+        if not os.path.exists(path):
+            _fail(ex, "flush never wrote the blackbox")
+        with open(path) as f:
+            dumped = [json.loads(line) for line in f if line.strip()]
+        dseqs = [e["seq"] for e in dumped if "seq" in e]
+        if dseqs != sorted(dseqs):
+            _fail(ex, f"flushed blackbox seq out of order: {dseqs}")
+    return ex
+
+
+#: scenario name -> callable(seed); ``--conc`` runs every scenario under
+#: ``CONC_SEEDS`` schedules and reports violations as findings
+SCENARIOS: typing.Dict[str, typing.Callable[[int], None]] = {
+    "engine-exactly-one-answer": scenario_engine_exactly_one_answer,
+    "router-owner-death-never-500": scenario_router_owner_death_never_500,
+    "blockpool-refcount-conservation":
+        scenario_blockpool_refcount_conservation,
+    "elastic-generation-monotonicity":
+        scenario_elastic_generation_monotonicity,
+    "flight-recorder-flush": scenario_flight_recorder_flush,
+}
+
+#: default schedule seeds per scenario (each seed is one full permuted
+#: schedule; the count trades CPU for interleaving coverage — the conc
+#: suite's budget note in docs/STATIC_ANALYSIS.md)
+CONC_SEEDS = tuple(range(10))
+
+
+def run_scenarios(names: typing.Optional[typing.Sequence[str]] = None,
+                  seeds: typing.Sequence[int] = CONC_SEEDS,
+                  edges: typing.Optional[set] = None
+                  ) -> typing.List[typing.Tuple[str, int, str]]:
+    """Run each scenario under every seed; returns violations as
+    ``(scenario, seed, message)`` (empty = every invariant held).  When
+    ``edges`` is a set, every explorer's observed lock-order edges are
+    added to it (conc_lint folds them into its ordering cycle check)."""
+    out = []
+    for name in (names or SCENARIOS):
+        fn = SCENARIOS[name]
+        for seed in seeds:
+            try:
+                ex = fn(int(seed))
+                if edges is not None and ex is not None:
+                    edges.update(ex.order_edges)
+            except AssertionError as e:
+                out.append((name, int(seed), str(e)))
+            except Exception as e:  # noqa: BLE001 — harness fault
+                out.append((name, int(seed),
+                            f"scenario harness error: {type(e).__name__}: "
+                            f"{e}"))
+    return out

@@ -12,10 +12,12 @@ import subprocess
 import threading
 import typing
 
+from ..utils import locks
+
 NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native")
-_lock = threading.Lock()
+_lock = locks.named_lock("_native._lock")
 _cache: typing.Dict[str, typing.Optional[ctypes.CDLL]] = {}
 
 

@@ -470,7 +470,8 @@ class Prefetcher:
                           ("queue",)).labels(**lab),
             )
         self.thread = threading.Thread(target=self._fill, args=(iterable,),
-                                       daemon=True)
+                                       daemon=True,
+                                       name="prefetcher-fill")
         self.thread.start()
 
     def _fill(self, iterable):

@@ -35,6 +35,7 @@ import uuid
 from .. import telemetry
 from ..telemetry import events as flight
 from ..telemetry import tracectx
+from ..utils import locks
 from ..config import ModelParameter
 from .interface import InterfaceWrapper
 from .serving_guard import (HTTPStatusError, ServingGuard, child_health,
@@ -875,7 +876,7 @@ def _http_child(port: int, paths: typing.List[str], requests, responses,
     # enough for the admission budget and the /ready watermark, and far
     # better than silently disabling both by reporting 0
     outstanding = [0]
-    outstanding_lock = threading.Lock()
+    outstanding_lock = locks.named_lock("rest_api.outstanding_lock")
 
     def queue_depth() -> int:
         # queued + in-decode: the device loop publishes how many requests

@@ -48,6 +48,7 @@ import urllib.request
 from .. import telemetry
 from ..telemetry import events as flight
 from ..telemetry import tracectx
+from ..utils import locks
 from .serving_guard import CircuitBreaker, HTTPStatusError
 
 #: endpoints the router forwards verbatim to a replica
@@ -106,7 +107,7 @@ class Replica:
         self.inflight = 0
         self.requests = 0
         self.failures = 0
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock(f"Replica{self.index}._lock")
 
     @property
     def base_url(self) -> str:
@@ -120,6 +121,12 @@ class Replica:
     def done(self) -> None:
         with self._lock:
             self.inflight = max(0, self.inflight - 1)
+
+    def note_failure(self) -> None:
+        """Locked failure-count bump: concurrent forwards must not lose
+        increments (GUARDED_BY: ``failures`` is lock-protected)."""
+        with self._lock:
+            self.failures += 1
 
 
 def _http_transport(replica: Replica, path: str, body: dict,
@@ -202,7 +209,15 @@ class GlobalPrefixIndex:
         #: whole-block token-prefix tuple -> replica index, LRU-ordered
         self._map: "collections.OrderedDict[tuple, int]" = \
             collections.OrderedDict()
-        self._lock = threading.Lock()
+        #: owner -> invalidation generation: bumped by invalidate_owner so
+        #: an ownership claim learned BEFORE an invalidation (a digest
+        #: fetched from a replica that then died, a forward answered by a
+        #: replica whose 5xx landed concurrently) can be told apart from
+        #: one learned after — callers snapshot owner_generation() before
+        #: the unlocked I/O and pass it back to record()/absorb(), which
+        #: drop the claim on mismatch instead of resurrecting a dead owner
+        self._gen: typing.Dict[int, int] = {}
+        self._lock = locks.named_lock("GlobalPrefixIndex._lock")
 
     def _prefixes(self, tokens) -> typing.List[tuple]:
         """Whole-block prefixes of ``tokens``, longest first."""
@@ -210,13 +225,26 @@ class GlobalPrefixIndex:
         bt = self.block_tokens
         return [toks[:i * bt] for i in range(len(toks) // bt, 0, -1)]
 
-    def record(self, tokens, owner: int) -> None:
+    def owner_generation(self, owner: int) -> int:
+        """Snapshot ``owner``'s invalidation generation BEFORE unlocked
+        I/O whose result will be fed to ``record``/``absorb``."""
+        with self._lock:
+            return self._gen.get(int(owner), 0)
+
+    def record(self, tokens, owner: int,
+               gen: typing.Optional[int] = None) -> None:
         """Mark ``owner`` as holding every whole-block prefix of
         ``tokens`` (radix semantics: holding a path implies holding its
-        ancestors)."""
+        ancestors).  With ``gen`` (an ``owner_generation`` snapshot), the
+        claim is dropped if ``owner`` was invalidated since the snapshot
+        — the fetch-then-insert race found by the interleaving explorer
+        (analysis/interleave.py 'router-owner-death-never-500')."""
+        owner = int(owner)
         with self._lock:
+            if gen is not None and gen != self._gen.get(owner, 0):
+                return
             for key in self._prefixes(tokens):
-                self._map[key] = int(owner)
+                self._map[key] = owner
                 self._map.move_to_end(key)
             while len(self._map) > self.cap:
                 self._map.popitem(last=False)
@@ -234,21 +262,38 @@ class GlobalPrefixIndex:
 
     def invalidate_owner(self, owner: int) -> int:
         """Drop every entry naming ``owner`` (replica death or open
-        breaker); returns the number dropped."""
+        breaker) and bump its generation so in-flight ownership claims
+        snapshotted before this call are rejected; returns the number
+        dropped."""
         with self._lock:
             dead = [k for k, v in self._map.items() if v == int(owner)]
             for k in dead:
                 del self._map[k]
+            self._gen[int(owner)] = self._gen.get(int(owner), 0) + 1
         return len(dead)
 
-    def absorb(self, owner: int, digest: dict) -> None:
+    def absorb(self, owner: int, digest: dict,
+               gen: typing.Optional[int] = None) -> None:
         """Fold one replica's ``/kv/blocks`` index digest (its
-        promote/evict report) into the global view."""
+        promote/evict report) into the global view.  ``gen`` is the
+        ``owner_generation`` snapshot taken BEFORE the digest was fetched:
+        if ``owner`` was invalidated while the fetch was in flight (it
+        5xx'd a concurrent forward and died), the whole digest is stale
+        and is dropped — checked and inserted under ONE lock acquisition
+        so no invalidation can land between the check and the insert."""
         bt = int(digest.get("block_tokens") or 0)
         if bt and bt != self.block_tokens:
             return  # mismatched block geometry is not addressable here
-        for path in digest.get("paths") or []:
-            self.record(path, owner)
+        owner = int(owner)
+        with self._lock:
+            if gen is not None and gen != self._gen.get(owner, 0):
+                return
+            for path in digest.get("paths") or []:
+                for key in self._prefixes(path):
+                    self._map[key] = owner
+                    self._map.move_to_end(key)
+            while len(self._map) > self.cap:
+                self._map.popitem(last=False)
 
     def __len__(self) -> int:
         with self._lock:
@@ -296,7 +341,7 @@ class Router:
         self._affinity: "collections.OrderedDict[tuple, int]" = \
             collections.OrderedDict()
         self._affinity_cap = 4096
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("Router._lock")
         r = telemetry.registry()
         self._m_requests = r.counter(
             "hbnlp_router_requests_total",
@@ -427,6 +472,8 @@ class Router:
         replica as owner of that whole-block token span (the on-forward
         half of global index maintenance)."""
         target = first
+        gen = self.gindex.owner_generation(target.index) \
+            if self.gindex is not None else None
         try:
             payload = self._forward_one(target, path, body, trace)
         except HTTPStatusError as e:
@@ -438,10 +485,16 @@ class Router:
             if not retry_on:
                 raise
             target = min(retry_on, key=lambda r: (r.inflight, r.index))
+            gen = self.gindex.owner_generation(target.index) \
+                if self.gindex is not None else None
             payload = self._forward_one(target, path, body, trace)
         if learn_span > 0 and self.gindex is not None:
+            # gen was snapshotted before the forward: if target was
+            # invalidated while this request was in flight (a concurrent
+            # forward saw it 5xx), record() drops the stale claim
             toks = body.get("tokens") or []
-            self.gindex.record(list(toks)[:learn_span], target.index)
+            self.gindex.record(list(toks)[:learn_span], target.index,
+                               gen=gen)
         return payload
 
     def _forward_disagg(self, path: str, body: dict,
@@ -543,7 +596,7 @@ class Router:
                     self.kv_transfer_timeout_s)
             except Exception:
                 # owner died mid-stream: its ownership is stale everywhere
-                src.failures += 1
+                src.note_failure()
                 src.breaker.record_failure()
                 self.gindex.invalidate_owner(src.index)
                 return False
@@ -559,7 +612,7 @@ class Router:
                 status, res = self.transport(dst, KV_BLOCKS_PATH, body,
                                              self.kv_transfer_timeout_s)
             except Exception:
-                dst.failures += 1
+                dst.note_failure()
                 dst.breaker.record_failure()
                 return False
             if status >= 400:
@@ -596,6 +649,10 @@ class Router:
         self._last_index_sync = now
         folded = 0
         for rep in self._usable():
+            # generation snapshot BEFORE the fetch: a replica that 5xxs a
+            # concurrent forward (invalidate_owner) while this scrape is
+            # in flight must not be resurrected by its own stale digest
+            gen = self.gindex.owner_generation(rep.index)
             try:
                 status, digest = self.transport(
                     rep, KV_BLOCKS_PATH, {"op": "index"},
@@ -604,7 +661,7 @@ class Router:
                 continue  # scrape is best-effort; forwards own the breaker
             if status >= 400:
                 continue
-            self.gindex.absorb(rep.index, digest)
+            self.gindex.absorb(rep.index, digest, gen=gen)
             folded += 1
         return folded
 
@@ -628,7 +685,7 @@ class Router:
             raise
         except Exception as e:  # connection refused / reset / timeout
             outcome = "unreachable"
-            replica.failures += 1
+            replica.note_failure()
             replica.breaker.record_failure()
             self._m_requests.labels(replica=str(replica.index),
                                     outcome="unreachable").inc()
@@ -650,7 +707,7 @@ class Router:
                 {"closed": 0, "half_open": 1, "open": 2}.get(
                     replica.breaker.state, 0))
         if status >= 500:
-            replica.failures += 1
+            replica.note_failure()
             replica.breaker.record_failure()
             self._m_requests.labels(replica=str(replica.index),
                                     outcome="server_error").inc()
@@ -809,7 +866,7 @@ def serve_replicated(params, workers: int = 1,
     try:
         fleet.start()
         server = threading.Thread(
-            target=_run_http,
+            target=_run_http, name="router-http",
             args=(port, paths, dispatch, workers),
             kwargs={"max_body_bytes": int(getattr(params,
                                                   "serve_max_body_bytes",

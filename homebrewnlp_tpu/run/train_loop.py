@@ -380,7 +380,9 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
     consumed_ref = [0]
     if is_chief and not params.use_random_dataloader:
         import threading as _threading
-        _flush_lock = _threading.Lock()
+
+        from ..utils import locks as _locks
+        _flush_lock = _locks.named_lock("train_loop._flush_lock")
         _flushed = [False]
 
         def datalog_flush(final: bool = False):
@@ -396,6 +398,9 @@ def train(params: ModelParameter, train_steps: typing.Optional[int] = None,
                 log = read_runs_log(params)
                 if log:
                     log[-1]["steps"] = consumed_ref[0]
+                    # IO under the lock is the POINT here: the first
+                    # writer must finish the rewrite before a racing
+                    # force-exit path starts  # graft-lint: allow[lock-blocking]
                     with fs.open_(fs.join(params.model_path,
                                           "DataLog.log"), "w") as f:
                         for entry in log:

@@ -37,6 +37,8 @@ import threading
 import time
 import typing
 
+from ..utils import locks
+
 
 def blackbox_path(model_path: str, tag: str) -> str:
     from ..utils import fs
@@ -69,7 +71,7 @@ class FlightRecorder:
         # thread, which may be interrupted mid-``record`` holding this
         # very lock — a plain Lock would deadlock the process inside its
         # own signal handler
-        self._lock = threading.RLock()
+        self._lock = locks.named_rlock("FlightRecorder._lock")
         self._events: typing.Deque[dict] = collections.deque(
             maxlen=max(1, int(capacity)))
         self._clock = clock
@@ -215,7 +217,7 @@ class FlightRecorder:
 # ---- process-wide instance --------------------------------------------------
 
 _recorder = FlightRecorder()
-_recorder_lock = threading.Lock()
+_recorder_lock = locks.named_lock("events._recorder_lock")
 
 
 def recorder() -> FlightRecorder:

@@ -26,6 +26,8 @@ import math
 import threading
 import typing
 
+from ..utils import locks
+
 #: default latency buckets (seconds): spans from sub-ms host ops to
 #: multi-minute checkpoint uploads
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -124,7 +126,7 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(sorted(float(b) for b in buckets)) \
             if kind == "histogram" else ()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock(f"_Metric._lock:{name}", meter=False)
         self._series: typing.Dict[LabelValues, typing.Any] = {}
         self._children: typing.Dict[LabelValues, _Child] = {}
         self._default = _Child(self, ())
@@ -167,7 +169,7 @@ class Registry:
     ``set_registry``)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("Registry._lock", meter=False)
         self._metrics: typing.Dict[str, _Metric] = {}
 
     def _get_or_create(self, name: str, help_: str, kind: str,
@@ -391,7 +393,7 @@ def with_labels(snap: dict, labels: typing.Dict[str, str]) -> dict:
 # ---- process-wide instance --------------------------------------------------
 
 _registry = Registry()
-_registry_lock = threading.Lock()
+_registry_lock = locks.named_lock("registry._registry_lock", meter=False)
 
 #: constant labels stamped onto every module-level ``snapshot()`` — the
 #: multi-host bootstrap sets {"process": "<index>"} once so every exported

@@ -1,0 +1,209 @@
+"""Named locks + opt-in runtime lock tracing (graft-lint ``--conc``).
+
+``named_lock``/``named_rlock`` are drop-in ``threading.Lock``/``RLock``
+factories the audited control-plane classes use so every lock carries
+the SAME name the ``GUARDED_BY`` registry declares
+(``analysis/conc_lint.py``).  Default mode returns the plain primitive
+— zero overhead, ``Condition``-compatible, nothing changes.
+
+With ``HBNLP_LOCK_TRACE=<dir>`` set at import time, the factories
+return :class:`TracedLock` instead: every acquisition appends one JSONL
+row to ``<dir>/lock_trace_<pid>.jsonl`` recording the lock name, the
+locks this thread already held (the acquisition-order edge), the wait
+time, and — at release — the hold time.  ``conc_lint.load_trace_edges``
+folds these observed edges into the same ordering cycle checker as the
+static ``with``-nesting graph and the interleaving explorer, so the
+declared discipline and observed reality cross-validate from real
+marker-suite runs.  Hold/wait times also feed the ``hbnlp_lock_*``
+telemetry series (docs/OBSERVABILITY.md) — registered lazily so the
+un-traced path never touches the registry.
+
+Tracing is per-process and write-only append; rows may tear at the tail
+of a live run, and the trace reader skips unparseable lines.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import typing
+
+__all__ = ["named_lock", "named_rlock", "TracedLock", "trace_dir"]
+
+#: thread-local stack of TracedLock names currently held (acquisition
+#: order) — the source of the observed lock-ordering edges
+_held = threading.local()
+
+
+def trace_dir() -> typing.Optional[str]:
+    """The active trace directory, or None when tracing is off."""
+    d = os.environ.get("HBNLP_LOCK_TRACE", "").strip()
+    return d or None
+
+
+def _held_stack() -> typing.List[str]:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+class _TraceSink:
+    """One append-only JSONL file per traced process; lazily opened,
+    shared by every TracedLock in the process."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._file = None
+        self._flock = threading.Lock()
+        self._metrics = None
+
+    def _ensure(self):
+        if self._file is None:
+            os.makedirs(self.directory, exist_ok=True)
+            path = os.path.join(self.directory,
+                                f"lock_trace_{os.getpid()}.jsonl")
+            self._file = open(path, "a", encoding="utf-8")
+        return self._file
+
+    def metrics(self):
+        """hbnlp_lock_* series, registered on first traced acquisition
+        (lazy: an un-traced process never creates them)."""
+        if self._metrics is None:
+            # the telemetry package __init__ rebinds `registry` to the
+            # accessor FUNCTION, shadowing the submodule
+            from ..telemetry import registry as _registry_fn
+            r = _registry_fn()
+            self._metrics = (
+                r.counter("hbnlp_lock_acquire_total",
+                          "Traced lock acquisitions", ("lock",)),
+                r.histogram("hbnlp_lock_wait_seconds",
+                            "Time spent waiting to acquire a traced "
+                            "lock", ("lock",),
+                            buckets=(1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)),
+                r.histogram("hbnlp_lock_hold_seconds",
+                            "Time a traced lock was held", ("lock",),
+                            buckets=(1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)),
+            )
+        return self._metrics
+
+    def write(self, row: dict) -> None:
+        try:
+            with self._flock:
+                f = self._ensure()
+                f.write(json.dumps(row) + "\n")
+                f.flush()
+        except OSError:
+            pass  # tracing must never take down the traced run
+
+
+_sink: typing.Optional[_TraceSink] = None
+_sink_lock = threading.Lock()
+
+
+def _get_sink(directory: str) -> _TraceSink:
+    global _sink
+    with _sink_lock:
+        if _sink is None or _sink.directory != directory:
+            _sink = _TraceSink(directory)
+        return _sink
+
+
+class TracedLock:
+    """Lock/RLock wrapper recording acquisition order + wait/hold times.
+
+    Not Condition-compatible (no ``_is_owned``): sites that build a
+    ``threading.Condition`` over their lock (``AsyncCheckpointer``) keep
+    the raw primitive even under tracing."""
+
+    def __init__(self, name: str, reentrant: bool, directory: str,
+                 meter: bool = True):
+        self.name = str(name)
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._reentrant = reentrant
+        self._sink = _get_sink(directory)
+        self._meter = meter
+        self._acquired_at = 0.0
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        import time
+        t0 = time.monotonic()
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            return False
+        waited = time.monotonic() - t0
+        stack = _held_stack()
+        # epoch stamp: trace rows are correlated with forensics blackbox
+        # wall stamps  # graft-lint: allow[wallclock]
+        row = {"t": round(time.time(), 6), "lock": self.name,
+               "held": list(stack), "wait_s": round(waited, 6)}
+        stack.append(self.name)
+        self._acquired_at = time.monotonic()
+        self._sink.write(row)
+        if self._meter:
+            try:
+                acq, wait_h, _ = self._sink.metrics()
+                acq.labels(lock=self.name).inc()
+                wait_h.labels(lock=self.name).observe(waited)
+            except Exception:
+                pass  # telemetry is best-effort under tracing
+        return True
+
+    def release(self) -> None:
+        import time
+        held_s = time.monotonic() - self._acquired_at
+        stack = _held_stack()
+        # innermost-first removal: re-entrant acquires push duplicates
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                break
+        self._lock.release()
+        if self._meter:
+            try:
+                _, _, hold_h = self._sink.metrics()
+                hold_h.labels(lock=self.name).observe(held_s)
+            except Exception:
+                pass
+        # graft-lint: allow[wallclock] — epoch stamp (see acquire)
+        self._sink.write({"t": round(time.time(), 6), "lock": self.name,
+                          "released": True, "hold_s": round(held_s, 6)})
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        if self._reentrant:
+            # RLock has no locked(); a non-blocking probe is close enough
+            if self._lock.acquire(blocking=False):
+                self._lock.release()
+                return False
+            return True
+        return self._lock.locked()
+
+
+def named_lock(name: str, meter: bool = True):
+    """A ``threading.Lock`` — or, under ``HBNLP_LOCK_TRACE``, a traced
+    wrapper reporting as ``name`` (use the ``Class.attr`` the GUARDED_BY
+    registry declares).  ``meter=False`` skips the hbnlp_lock_* series
+    (required for the telemetry registry\'s OWN locks, which cannot meter
+    themselves without recursing); the JSONL rows still record."""
+    d = trace_dir()
+    if d is None:
+        return threading.Lock()
+    return TracedLock(name, reentrant=False, directory=d, meter=meter)
+
+
+def named_rlock(name: str, meter: bool = True):
+    """``named_lock`` for re-entrant sites (signal handlers that re-enter
+    the flight recorder)."""
+    d = trace_dir()
+    if d is None:
+        return threading.RLock()
+    return TracedLock(name, reentrant=True, directory=d, meter=meter)

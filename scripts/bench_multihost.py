@@ -241,21 +241,30 @@ def _spawn_fleet(strategy: str, nproc: int, steps: int, batch_per_slice: int,
     with fewer cores than processes occasionally starve the coordination
     heartbeat (the whole fleet SIGABRTs with 'another task died'), which
     is scheduler pressure, not a property of the strategy under test."""
+    from tests.multihost_test import starvation_retry_reason
     for attempt in range(retries + 1):
-        row = _spawn_fleet_once(strategy, nproc, steps, batch_per_slice,
-                                timeout, extra_args)
+        row, rcs, outs = _spawn_fleet_once(strategy, nproc, steps,
+                                           batch_per_slice, timeout,
+                                           extra_args)
         if row is not None:
             return row
         if attempt < retries:
-            print(f"  {strategy} x{nproc}: retrying after fleet failure",
-                  flush=True)
+            reason = starvation_retry_reason(rcs, outs)
+            print(f"  {strategy} x{nproc}: retrying after fleet failure"
+                  + (f" — {reason}" if reason else ""), flush=True)
     return None
 
 
 def _spawn_fleet_once(strategy: str, nproc: int, steps: int,
                       batch_per_slice: int, timeout: int,
                       extra_args: typing.Sequence[str] = ()
-                      ) -> typing.Optional[dict]:
+                      ) -> typing.Tuple[typing.Optional[dict],
+                                        typing.List[int],
+                                        typing.List[str]]:
+    """One attempt; returns ``(result_row_or_None, worker_rcs, worker
+    outputs)`` so the retry loop can classify the failure shape (the
+    shared 1-core gloo-SIGABRT starvation classifier in
+    tests/multihost_test.py)."""
     port = _free_port()
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                    os.environ.get("XLA_FLAGS", ""))
@@ -283,19 +292,20 @@ def _spawn_fleet_once(strategy: str, nproc: int, steps: int,
                 q.kill()
             print(f"  {strategy} x{nproc}: TIMEOUT after {timeout}s",
                   flush=True)
-            return None
+            return None, [p.returncode or -9 for p in procs], outs
         outs.append(out)
+    rcs = [p.returncode for p in procs]
     for pid, (p, out) in enumerate(zip(procs, outs)):
         if p.returncode != 0:
             print(f"  {strategy} x{nproc}: worker {pid} failed "
                   f"(rc={p.returncode}):\n{out[-2000:]}", flush=True)
-            return None
+            return None, rcs, outs
     for out in outs:
         for line in out.splitlines():
             if line.startswith("BENCH_MULTIHOST_RESULT "):
-                return json.loads(line.split(" ", 1)[1])
+                return json.loads(line.split(" ", 1)[1]), rcs, outs
     print(f"  {strategy} x{nproc}: no result line emitted", flush=True)
-    return None
+    return None, rcs, outs
 
 
 def run_sweep(strategies: typing.List[str], proc_counts: typing.List[int],

@@ -308,7 +308,7 @@ def _spawn(interface, engine: str, slots: int, batch: int, spec_k: int = 8,
     stop = threading.Event()
     t = threading.Thread(target=rest_api.serve, args=(params, interface),
                          kwargs={"port": port, "isolate": True, "stop": stop},
-                         daemon=True)
+                         daemon=True, name="bench-server")
     t.start()
     return port, stop, t
 
@@ -458,7 +458,8 @@ def _closed_loop(port, rng, workers: int, per_worker: int, orbit=None,
                     trace_ids.append((tid, time.monotonic() - t_req))
 
     t0 = time.monotonic()
-    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True,
+                                name=f"bench-worker-{w}")
                for w in range(workers)]
     for t in threads:
         t.start()
@@ -485,7 +486,8 @@ def _open_loop(port, rng, rate_rps: float, duration_s: float, orbit=None):
                 return
             stats.record(status, body, plen)
 
-        th = threading.Thread(target=fire, daemon=True)
+        th = threading.Thread(target=fire, daemon=True,
+                              name=f"bench-fire-{len(threads)}")
         th.start()
         threads.append(th)
         time.sleep(float(rng.exponential(1.0 / rate_rps)))
@@ -717,11 +719,13 @@ def run_shared_prefix(args) -> dict:
                     pass
                 time.sleep(0.15)
 
-        sampler = threading.Thread(target=sample, daemon=True)
+        sampler = threading.Thread(target=sample, daemon=True,
+                                   name="bench-occupancy-sampler")
         sampler.start()
         occ_threads = [threading.Thread(
             target=_post, args=(port, {"tokens": [5 + i], "max_tokens": 16,
-                                       "temperature": 0.0}), daemon=True)
+                                       "temperature": 0.0}), daemon=True,
+            name=f"bench-occ-{i}")
             for i in range(args.slots)]
         for th in occ_threads:
             th.start()
@@ -1033,7 +1037,7 @@ def _run_replica_point(n: int, wait_s: float, args) -> dict:
         # non-daemonic replicas: start() under the finally that stops them
         fleet.start()
         threading.Thread(
-            target=rest_api._run_http,
+            target=rest_api._run_http, name="bench-router-http",
             args=(router_port, ["/token_completion", "/health", "/metrics"],
                   dispatch, 1), daemon=True).start()
         deadline = time.monotonic() + 600
@@ -1055,7 +1059,8 @@ def _run_replica_point(n: int, wait_s: float, args) -> dict:
                 payload, _ = _replica_request(warm_rng, i)
                 th = threading.Thread(target=_post,
                                       args=(router_port, payload),
-                                      daemon=True)
+                                      daemon=True,
+                                      name=f"bench-warm-{i}")
                 th.start()
                 threads.append(th)
             for th in threads:
@@ -1077,7 +1082,8 @@ def _run_replica_point(n: int, wait_s: float, args) -> dict:
                 stats.record(status, body, plen)
 
         t0 = time.monotonic()
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True,
+                                    name=f"bench-worker-{w}")
                    for w in range(workers)]
         for th in threads:
             th.start()
@@ -1339,8 +1345,9 @@ def _run_disagg_tier(label: str, classes, args, wait_s: float) -> dict:
         return _post(router_port, payload, timeout=timeout, headers=headers)
 
     def fire_all(payloads):
-        threads = [threading.Thread(target=fire, args=(p,), daemon=True)
-                   for p in payloads]
+        threads = [threading.Thread(target=fire, args=(p,), daemon=True,
+                                    name=f"bench-fire-{j}")
+                   for j, p in enumerate(payloads)]
         for th in threads:
             th.start()
         for th in threads:
@@ -1353,7 +1360,7 @@ def _run_disagg_tier(label: str, classes, args, wait_s: float) -> dict:
     try:
         fleet.start()
         threading.Thread(
-            target=rest_api._run_http,
+            target=rest_api._run_http, name="bench-disagg-router-http",
             args=(router_port,
                   ["/token_completion", "/health", "/metrics"],
                   dispatch, max(8, args.concurrency)), daemon=True).start()
@@ -1413,7 +1420,8 @@ def _run_disagg_tier(label: str, classes, args, wait_s: float) -> dict:
                     results.append((kind, wall, status, gen, tid))
 
         t0 = time.monotonic()
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True,
+                                    name=f"bench-worker-{w}")
                    for w in range(workers)]
         for th in threads:
             th.start()

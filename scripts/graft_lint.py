@@ -12,6 +12,14 @@ Three pass families (docs/STATIC_ANALYSIS.md):
           step): donation, big-copy, dtype-promotion, collective census vs
           ``analysis/budgets.json``, host-sync, cost-ledger regression.
           Compiles a small audit model on the current backend (~15 s CPU).
+  --conc  host-concurrency audit (analysis/conc_lint.py): lock-discipline
+          AST lint over the serving/elastic control plane (GUARDED_BY
+          registry, blocking-call-under-lock, lock-ordering cycles,
+          thread hygiene) plus the deterministic interleaving explorer
+          (analysis/interleave.py) replaying the control-plane scenarios
+          under permuted schedules.  With ``HBNLP_LOCK_TRACE=<dir>``
+          pointing at a recorded run, the observed acquisition-order
+          edges join the same cycle check.
   --mesh  mesh-aware audit (analysis/mesh_audit.py): the registered entry
           points lowered under every pod_lowering strategy (dp x tp, ring
           SP, MoE EP, the pipeline schedules) on 8 virtual CPU devices —
@@ -47,6 +55,17 @@ sys.path.insert(0, REPO)
 def run_ast() -> list:
     from homebrewnlp_tpu.analysis import ast_lint
     return ast_lint.lint_repo()
+
+
+def run_conc() -> list:
+    # the blockpool scenario imports infer/paged -> engine -> jax; pin the
+    # platform so --conc never grabs a TPU from a CI box that has one
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from homebrewnlp_tpu.analysis import conc_lint
+    edges = set()
+    findings = conc_lint.explorer_findings(edges=edges)
+    findings += conc_lint.lint_repo_conc(extra_edges=edges)
+    return findings
 
 
 def run_hlo(budgets_path=None, ledger_path=None) -> list:
@@ -119,6 +138,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--ast", action="store_true",
                     help="AST rules only (fast, no jax)")
+    ap.add_argument("--conc", action="store_true",
+                    help="host-concurrency audit only (lock lint + "
+                         "interleaving explorer)")
     ap.add_argument("--hlo", action="store_true",
                     help="compiled-HLO entry-point audit only")
     ap.add_argument("--mesh", action="store_true",
@@ -133,8 +155,9 @@ def main(argv=None) -> int:
                     help="alternate cost_ledger.json (default: "
                          "analysis/cost_ledger.json)")
     args = ap.parse_args(argv)
-    none_picked = not (args.ast or args.hlo or args.mesh)
+    none_picked = not (args.ast or args.conc or args.hlo or args.mesh)
     do_ast = args.ast or args.all or none_picked
+    do_conc = args.conc or args.all or none_picked
     do_hlo = args.hlo or args.all or none_picked
     do_mesh = args.mesh or args.all or none_picked
 
@@ -142,6 +165,8 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     if do_ast:
         findings += run_ast()
+    if do_conc:
+        findings += run_conc()
     if do_hlo:
         findings += run_hlo(args.budgets, args.ledger)
     if do_mesh:
@@ -151,7 +176,8 @@ def main(argv=None) -> int:
     for f in findings:
         print(f)
     per_rule = collections.Counter(f.rule for f in findings)
-    halves = "+".join(h for h, on in (("ast", do_ast), ("hlo", do_hlo),
+    halves = "+".join(h for h, on in (("ast", do_ast), ("conc", do_conc),
+                                      ("hlo", do_hlo),
                                       ("mesh", do_mesh)) if on)
     if findings:
         summary = ", ".join(f"{rule}: {n}" for rule, n

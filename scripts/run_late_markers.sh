@@ -18,7 +18,7 @@ MARKERS=("$@")
 if [ ${#MARKERS[@]} -eq 0 ]; then
   MARKERS=(serving contbatch distributed specdecode specpaged
            staticanalysis attribution pagedkv router elastic forensics
-           disagg)
+           disagg conc)
 fi
 PER_SUITE_TIMEOUT="${LATE_MARKER_TIMEOUT:-900}"
 # the elastic suite runs two full controller e2es (multiple jax fleet

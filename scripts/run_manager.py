@@ -293,7 +293,7 @@ class Fleet(Manager):
                                  stderr=subprocess.STDOUT, text=True,
                                  preexec_fn=os.setsid)
             t = threading.Thread(target=self._pump, args=(pid, p.stdout),
-                                 daemon=True)
+                                 daemon=True, name=f"fleet-pump-{pid}")
             t.start()
             self._pump_threads.append(t)
             procs.append(p)

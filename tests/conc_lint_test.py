@@ -1,0 +1,556 @@
+"""graft-lint ``--conc`` tier-1 suite: the host-concurrency audit is
+clean at HEAD AND every rule + explorer invariant is proven to bite.
+
+Mirrors tests/static_analysis_test.py: positive checks pin the repo
+clean (the static lock-discipline pass over homebrewnlp_tpu/ + scripts/,
+and the scenario library under every default seed); each AST rule then
+gets a negative control — synthetic source carrying exactly the
+violation the rule exists to catch — and the explorer gets synthetic
+deadlock and lost-update harnesses it MUST catch, plus the found-race
+regression: the GlobalPrefixIndex sync-vs-invalidate resurrection the
+explorer surfaced, replayed against the real class with the
+owner-generation guard on and off.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from homebrewnlp_tpu.analysis import conc_lint, interleave
+from homebrewnlp_tpu.analysis.conc_lint import lint_source
+
+pytestmark = pytest.mark.conc
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# ---- positive: the repo at HEAD is clean -----------------------------------
+
+def conc_static_repo_clean_test():
+    """Static half (lock-guard, lock-blocking, lock-order, thread-hygiene,
+    conc-registry) over the whole repo: zero findings at HEAD."""
+    findings = conc_lint.lint_repo_conc()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def explorer_scenarios_all_seeds_clean_test():
+    """Every scenario holds its invariant under every default schedule
+    seed — the ``--conc`` CLI's exploration half at HEAD."""
+    violations = interleave.run_scenarios()
+    assert violations == [], "\n".join(
+        f"{n}@seed{s}: {m}" for n, s, m in violations)
+
+
+# ---- lock-guard: negative controls -----------------------------------------
+
+_REG = {
+    "x.py::Box": {"lock": "_lock", "guards": {"_items": "rw",
+                                              "count": "w"}},
+}
+
+_GUARD_BAD_READ = """\
+class Box:
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._items = []
+        self.count = 0
+
+    def peek(self):
+        return self._items[-1]
+"""
+
+_GUARD_OK = """\
+class Box:
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._items = []
+        self.count = 0
+
+    def peek(self):
+        with self._lock:
+            return self._items[-1]
+"""
+
+
+def lock_guard_negative_control_test():
+    findings = lint_source("x.py", _GUARD_BAD_READ, _REG)
+    assert [f.rule for f in findings] == ["lock-guard"]
+    assert "self._items" in findings[0].message
+    assert "Box.peek" in findings[0].entry
+    assert lint_source("x.py", _GUARD_OK, _REG) == []
+
+
+def lock_guard_init_exempt_test():
+    """Attribute establishment in __init__ precedes sharing — exempt
+    (both snippets above assign guarded attrs unlocked in __init__)."""
+    only_init = ("class Box:\n"
+                 "    def __init__(self):\n"
+                 "        self._lock = None\n"
+                 "        self._items = []\n"
+                 "        self.count = 0\n")
+    assert lint_source("x.py", only_init, _REG) == []
+
+
+def lock_guard_write_only_mode_test():
+    """Mode "w": unlocked WRITES are flagged, unlocked READS pass (the
+    benignly-racy Replica.inflight load-balance hint)."""
+    bad_write = ("class Box:\n"
+                 "    def bump(self):\n"
+                 "        self.count += 1\n")
+    findings = lint_source("x.py", bad_write, _REG)
+    assert [f.rule for f in findings] == ["lock-guard"]
+    ok_read = ("class Box:\n"
+               "    def hint(self):\n"
+               "        return self.count\n")
+    assert lint_source("x.py", ok_read, _REG) == []
+
+
+def lock_guard_other_object_prefix_test():
+    """The prefix-held semantics track the HOLDER object: ``with m._lock``
+    legalizes ``m._items``, not ``other._items``."""
+    ok = ("def drain(m):\n"
+          "    with m._lock:\n"
+          "        return list(m._items)\n")
+    assert lint_source("x.py", ok, _REG) == []
+    bad = ("def steal(m, other):\n"
+           "    with m._lock:\n"
+           "        return list(other._items)\n")
+    findings = lint_source("x.py", bad, _REG)
+    assert [f.rule for f in findings] == ["lock-guard"]
+    assert "other._items" in findings[0].message
+
+
+def lock_guard_nested_def_resets_held_test():
+    """A nested def runs LATER: locks held at definition time are not
+    held at call time."""
+    bad = ("class Box:\n"
+           "    def sched(self):\n"
+           "        with self._lock:\n"
+           "            def later():\n"
+           "                return self._items[-1]\n"
+           "            return later\n")
+    findings = lint_source("x.py", bad, _REG)
+    assert [f.rule for f in findings] == ["lock-guard"]
+
+
+def lock_guard_suppression_test():
+    marked = ("class Box:\n"
+              "    def peek(self):\n"
+              "        return self._items[-1]  # graft-lint: "
+              "allow[lock-guard]\n")
+    assert lint_source("x.py", marked, _REG) == []
+    line_above = ("class Box:\n"
+                  "    def peek(self):\n"
+                  "        # graft-lint: allow[lock-guard]\n"
+                  "        return self._items[-1]\n")
+    assert lint_source("x.py", line_above, _REG) == []
+    # rule-scoped: an allow for a DIFFERENT rule does not blanket this one
+    wrong = ("class Box:\n"
+             "    def peek(self):\n"
+             "        return self._items[-1]  # graft-lint: "
+             "allow[lock-blocking]\n")
+    assert [f.rule for f in lint_source("x.py", wrong, _REG)] \
+        == ["lock-guard"]
+
+
+# ---- lock-blocking: negative controls --------------------------------------
+
+def lock_blocking_negative_control_test():
+    bad = ("import time\n"
+           "def hold(lock):\n"
+           "    with lock:\n"
+           "        time.sleep(1.0)\n")
+    findings = lint_source("x.py", bad)
+    assert [f.rule for f in findings] == ["lock-blocking"]
+    assert "time.sleep" in findings[0].message
+    ok = ("import time\n"
+          "def hold(lock):\n"
+          "    with lock:\n"
+          "        pass\n"
+          "    time.sleep(1.0)\n")
+    assert lint_source("x.py", ok) == []
+
+
+def lock_blocking_io_variants_test():
+    for call in ("open('f')", "fs.open_('f')", "subprocess.run(cmd)",
+                 "urllib.request.urlopen(u)", "self._q.get()",
+                 "sock.recv(1)"):
+        bad = (f"def hold(self, lock, fs, subprocess, urllib, cmd, u, "
+               f"sock):\n"
+               f"    with lock:\n"
+               f"        {call}\n")
+        findings = lint_source("x.py", bad)
+        assert [f.rule for f in findings] == ["lock-blocking"], call
+    # pure path helpers on the fs seam never block
+    ok = ("def hold(lock, fs):\n"
+          "    with lock:\n"
+          "        return fs.join('a', 'b')\n")
+    assert lint_source("x.py", ok) == []
+
+
+def lock_blocking_suppression_test():
+    marked = ("import time\n"
+              "def hold(lock):\n"
+              "    with lock:\n"
+              "        time.sleep(1.0)  # graft-lint: "
+              "allow[lock-blocking]\n")
+    assert lint_source("x.py", marked) == []
+
+
+# ---- lock-order: negative controls -----------------------------------------
+
+def lock_order_cycle_negative_control_test():
+    """Two functions nesting the same two locks in opposite order — the
+    classic AB/BA deadlock — produce exactly one cycle finding."""
+    bad = ("class M:\n"
+           "    def ab(self):\n"
+           "        with self._a_lock:\n"
+           "            with self._b_lock:\n"
+           "                pass\n"
+           "    def ba(self):\n"
+           "        with self._b_lock:\n"
+           "            with self._a_lock:\n"
+           "                pass\n")
+    findings = lint_source("x.py", bad)
+    assert [f.rule for f in findings] == ["lock-order"]
+    assert "M._a_lock" in findings[0].entry
+    assert "M._b_lock" in findings[0].entry
+    # consistent order across every site: no cycle
+    ok = bad.replace("with self._b_lock:\n            "
+                     "with self._a_lock:",
+                     "with self._a_lock:\n            "
+                     "with self._b_lock:")
+    assert lint_source("x.py", ok) == []
+
+
+def lock_order_merges_external_edges_test():
+    """order_findings is the shared checker: static edges + explorer
+    edges + runtime-trace edges all fold into one graph."""
+    assert conc_lint.order_findings({("A", "B"), ("B", "C")}) == []
+    cyc = conc_lint.order_findings({("A", "B"), ("B", "C"), ("C", "A")})
+    assert [f.rule for f in cyc] == ["lock-order"]
+
+
+def runtime_trace_edges_roundtrip_test(tmp_path):
+    """utils/locks.py JSONL rows parse into edges; a torn tail line is
+    skipped; a cyclic observed order is flagged."""
+    rows = [{"t": 1.0, "lock": "B", "held": ["A"], "wait_s": 0.0},
+            {"t": 2.0, "lock": "A", "held": ["B"], "wait_s": 0.0}]
+    p = tmp_path / "lock_trace_1234.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows)
+                 + '\n{"torn": tru')
+    edges = conc_lint.load_trace_edges(str(tmp_path))
+    assert edges == {("A", "B"), ("B", "A")}
+    findings = conc_lint.trace_findings(str(tmp_path))
+    assert [f.rule for f in findings] == ["lock-order"]
+
+
+def traced_lock_records_and_meters_test(tmp_path, monkeypatch):
+    """End-to-end through utils/locks.py: a traced named lock writes
+    acquire/release rows and registers the hbnlp_lock_* series."""
+    monkeypatch.setenv("HBNLP_LOCK_TRACE", str(tmp_path))
+    monkeypatch.setattr("homebrewnlp_tpu.utils.locks._sink", None)
+    from homebrewnlp_tpu.telemetry.registry import Registry, set_registry
+    from homebrewnlp_tpu.utils import locks
+    r = Registry()
+    old = set_registry(r)
+    try:
+        outer = locks.named_lock("T.outer")
+        inner = locks.named_lock("T.inner")
+        assert isinstance(outer, locks.TracedLock)
+        with outer:
+            with inner:
+                pass
+        edges = conc_lint.load_trace_edges(str(tmp_path))
+        assert ("T.outer", "T.inner") in edges
+        names = {s for s in map(str, r.snapshot())}
+        assert "hbnlp_lock_acquire_total" in names
+        assert "hbnlp_lock_wait_seconds" in names
+        assert "hbnlp_lock_hold_seconds" in names
+    finally:
+        set_registry(old)
+
+
+def named_lock_untraced_is_plain_primitive_test(monkeypatch):
+    """Without HBNLP_LOCK_TRACE the factories return the raw primitives —
+    zero overhead, Condition-compatible."""
+    import threading
+    monkeypatch.delenv("HBNLP_LOCK_TRACE", raising=False)
+    from homebrewnlp_tpu.utils import locks
+    assert isinstance(locks.named_lock("x"), type(threading.Lock()))
+    assert isinstance(locks.named_rlock("x"), type(threading.RLock()))
+
+
+# ---- thread-hygiene: negative controls -------------------------------------
+
+def thread_hygiene_negative_controls_test():
+    no_name = ("import threading\n"
+               "t = threading.Thread(target=f, daemon=True)\n")
+    assert [f.rule for f in lint_source("x.py", no_name)] \
+        == ["thread-hygiene"]
+    no_daemon = ("import threading\n"
+                 "t = threading.Thread(target=f, name='w')\n")
+    assert [f.rule for f in lint_source("x.py", no_daemon)] \
+        == ["thread-hygiene"]
+    no_join = ("import threading\n"
+               "t = threading.Thread(target=f, name='w', daemon=False)\n")
+    findings = lint_source("x.py", no_join)
+    assert [f.rule for f in findings] == ["thread-hygiene"]
+    assert "join" in findings[0].message
+    ok_daemon = ("import threading\n"
+                 "t = threading.Thread(target=f, name='w', daemon=True)\n")
+    assert lint_source("x.py", ok_daemon) == []
+    ok_joined = ("import threading\n"
+                 "t = threading.Thread(target=f, name='w', daemon=False)\n"
+                 "t.start()\n"
+                 "t.join()\n")
+    assert lint_source("x.py", ok_joined) == []
+
+
+# ---- conc-registry: stale-entry controls -----------------------------------
+
+def conc_registry_stale_entries_test(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        "class Real:\n"
+        "    def __init__(self):\n"
+        "        self._lock = None\n"
+        "        self._state = {}\n")
+    ok = {"mod.py::Real": {"lock": "_lock", "guards": {"_state": "rw"}}}
+    assert conc_lint.registry_findings(str(tmp_path), ok) == []
+    stale = {
+        "gone.py::Real": {"lock": "_lock", "guards": {}},
+        "mod.py::Ghost": {"lock": "_lock", "guards": {}},
+        "mod.py::Real": {"lock": "_lock",
+                         "guards": {"_renamed_attr": "rw"}},
+    }
+    findings = conc_lint.registry_findings(str(tmp_path), stale)
+    assert [f.rule for f in findings] == ["conc-registry"] * 3
+    messages = "\n".join(f.message for f in findings)
+    assert "gone.py" in messages and "Ghost" in messages \
+        and "_renamed_attr" in messages
+
+
+# ---- explorer: determinism + it must catch seeded bugs ---------------------
+
+def explorer_seed_reproducible_test():
+    """Same seed + same task code => byte-identical schedule and effects;
+    different seeds diverge somewhere across a batch."""
+    def run(seed):
+        ex = interleave.Explorer(seed)
+        lock = ex.lock("L")
+        out = []
+
+        def worker(tag):
+            def fn():
+                for i in range(3):
+                    with lock:
+                        out.append(f"{tag}{i}")
+            return fn
+
+        ex.task(worker("a"), "a")
+        ex.task(worker("b"), "b")
+        ex.run()
+        return tuple(ex.trace), tuple(out)
+
+    assert run(3) == run(3)
+    assert len({run(s) for s in range(8)}) > 1
+
+
+def explorer_catches_seeded_deadlock_test():
+    """The synthetic AB/BA deadlock: some schedule MUST reach the cross
+    hold-and-wait and raise DeadlockError naming both waiters."""
+    def attempt(seed):
+        ex = interleave.Explorer(seed)
+        a, b = ex.lock("A"), ex.lock("B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        ex.task(ab, "t-ab")
+        ex.task(ba, "t-ba")
+        try:
+            ex.run()
+        except interleave.DeadlockError as e:
+            assert len(e.waiters) == 2
+            return True, ex
+        return False, ex
+
+    hits = [seed for seed in range(10) if attempt(seed)[0]]
+    assert hits, "no schedule in 10 seeds reached the AB/BA deadlock"
+    # and the observed edges alone reveal the cycle statically
+    edges = set()
+    for seed in range(10):
+        edges |= attempt(seed)[1].order_edges
+    assert conc_lint.order_findings(edges), \
+        "explorer edges did not expose the AB/BA cycle"
+
+
+def explorer_catches_seeded_lost_update_test():
+    """An unlocked read-modify-write (the bug class the lock-guard rule
+    bans): the explorer MUST find a schedule that loses an update; the
+    locked version never does."""
+    def attempt(seed, locked):
+        ex = interleave.Explorer(seed)
+        lock = ex.lock("L")
+        box = {"n": 0}
+
+        def bump():
+            for _ in range(3):
+                if locked:
+                    with lock:
+                        v = box["n"]
+                        ex.step("rmw")
+                        box["n"] = v + 1
+                else:
+                    v = box["n"]
+                    ex.step("rmw")  # preemption inside the RMW window
+                    box["n"] = v + 1
+
+        ex.task(bump, "w1")
+        ex.task(bump, "w2")
+        ex.run()
+        return box["n"]
+
+    unlocked = [attempt(s, locked=False) for s in range(10)]
+    assert any(n < 6 for n in unlocked), \
+        f"no schedule lost an update: {unlocked}"
+    assert all(attempt(s, locked=True) == 6 for s in range(10))
+
+
+def explored_lock_reentrancy_test():
+    """rlock() re-enters; a plain explored lock self-deadlocks instead of
+    silently recursing."""
+    ex = interleave.Explorer(0)
+    r = ex.rlock("R")
+
+    def nest():
+        with r:
+            with r:
+                pass
+
+    ex.task(nest, "n")
+    ex.run()  # completes: reentrant
+
+    ex2 = interleave.Explorer(0)
+    plain = ex2.lock("P")
+
+    def self_deadlock():
+        with plain:
+            with plain:
+                pass
+
+    ex2.task(self_deadlock, "n")
+    with pytest.raises(interleave.DeadlockError):
+        ex2.run()
+
+
+# ---- the found race: sync_global_index vs invalidate-on-owner-death --------
+
+def _gindex_resurrection_attempt(seed, with_gen):
+    """Replay the exact race ``--conc`` surfaced against the REAL
+    GlobalPrefixIndex: a syncer fetches replica 1's digest BEFORE the
+    owner dies, then absorbs it AFTER invalidate_owner ran.  Without the
+    owner-generation guard the stale digest resurrects the dead owner's
+    entries; with it the absorb is dropped.  ``with_gen=False`` models
+    the pre-fix absorb (no generation snapshot)."""
+    from homebrewnlp_tpu.infer.router import GlobalPrefixIndex
+
+    ex = interleave.Explorer(seed)
+    g = GlobalPrefixIndex(block_tokens=4)
+    interleave.wrap_lock(ex, g, "_lock", "gindex")
+    g.record([1, 2, 3, 4], 1)
+    state = {"killed": False, "fetch_before_kill": False}
+
+    def syncer():
+        gen = g.owner_generation(1)
+        digest = {"block_tokens": 4, "paths": [[1, 2, 3, 4]]}
+        # the transport fetch happened strictly before the kill iff the
+        # killer has not run yet (killer flips the flag FIRST, so a torn
+        # observation can only under-count violations, never invent one)
+        state["fetch_before_kill"] = not state["killed"]
+        ex.step("fetched")
+        g.absorb(1, digest, gen=gen if with_gen else None)
+
+    def killer():
+        state["killed"] = True
+        g.invalidate_owner(1)
+
+    ex.task(syncer, "syncer")
+    ex.task(killer, "killer")
+    ex.run()
+    owner, _ = g.lookup([1, 2, 3, 4])
+    return owner == 1 and state["fetch_before_kill"]
+
+
+def gindex_stale_absorb_race_regression_test():
+    """Pre-fix semantics (absorb without a generation snapshot) MUST show
+    the resurrection under some deterministic schedule — proof the
+    explorer finds the real race — and the shipped generation guard
+    closes it under every one of those schedules."""
+    pre = [s for s in range(20)
+           if _gindex_resurrection_attempt(s, with_gen=False)]
+    assert pre, "no schedule reproduced the stale-absorb resurrection"
+    post = [s for s in range(20)
+            if _gindex_resurrection_attempt(s, with_gen=True)]
+    assert post == [], \
+        f"generation guard failed to close the race under seeds {post}"
+
+
+def gindex_generation_guard_unit_test():
+    """The fix's synchronous contract, no explorer: a gen snapshotted
+    before invalidate_owner voids both record() and absorb()."""
+    from homebrewnlp_tpu.infer.router import GlobalPrefixIndex
+
+    g = GlobalPrefixIndex(block_tokens=4)
+    stale = g.owner_generation(2)
+    g.invalidate_owner(2)
+    g.record([5, 6, 7, 8], 2, gen=stale)
+    assert g.lookup([5, 6, 7, 8]) == (None, 0)
+    g.absorb(2, {"block_tokens": 4, "paths": [[5, 6, 7, 8]]}, gen=stale)
+    assert g.lookup([5, 6, 7, 8]) == (None, 0)
+    # a current-generation claim still lands
+    g.record([5, 6, 7, 8], 2, gen=g.owner_generation(2))
+    assert g.lookup([5, 6, 7, 8])[0] == 2
+
+
+# ---- the CLI ---------------------------------------------------------------
+
+def graft_lint_cli_conc_clean_test():
+    """`graft_lint.py --conc` exits 0 on the repo at HEAD (static rules +
+    registry check + explorer sweep in one subprocess)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graft_lint.py"),
+         "--conc"], capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+    assert "[conc]" in proc.stdout
+
+
+def graft_lint_cli_conc_reports_findings_test(monkeypatch):
+    """Seeded conc findings drive exit 1 + the per-rule summary, same
+    semantics as every other graft-lint family."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import graft_lint
+    finally:
+        sys.path.pop(0)
+    fake = [conc_lint.Finding("lock-guard", "x.py:Box.peek", "seeded"),
+            conc_lint.Finding("interleave", "s@seed0", "seeded")]
+    monkeypatch.setattr(graft_lint, "run_conc", lambda: list(fake))
+    assert graft_lint.main(["--conc"]) == 1
+    monkeypatch.setattr(graft_lint, "run_conc", lambda: [])
+    assert graft_lint.main(["--conc"]) == 0
